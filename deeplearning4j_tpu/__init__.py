@@ -1,0 +1,61 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework with the
+capability surface of Deeplearning4j (ShinichR/deeplearning4j fork of the
+eclipse/deeplearning4j monorepo).
+
+This is NOT a port: the compute path is JAX/XLA/Pallas (whole-graph compile,
+SPMD over `jax.sharding.Mesh`), the runtime around it is Python + a C++ host
+core. Reference parity is tracked against SURVEY.md's component inventory;
+docstrings cite reference components by file/class (line numbers unavailable —
+reference mount was empty at survey time, see SURVEY.md §0).
+
+Top-level namespaces (reference equivalents in brackets):
+
+- ``ndarray``  — eager INDArray-parity tensor API          [nd4j-api INDArray/Nd4j]
+- ``ops``      — op namespaces + executioner/profiler      [org.nd4j.linalg.api.ops]
+- ``autodiff`` — define-then-run graph, whole-graph compile [SameDiff]
+- ``nn``       — configs, layers, MultiLayerNetwork/ComputationGraph
+                                                            [deeplearning4j-nn]
+- ``data``     — ETL: records, transforms, iterators        [datavec]
+- ``models``   — model zoo                                  [deeplearning4j-zoo]
+- ``parallel`` — mesh/sharding presets, distributed train   [dl4j-spark, ParallelWrapper]
+- ``kernels``  — Pallas kernels (flash/ring attention, …)   [libnd4j helpers/cuda]
+- ``eval``     — Evaluation/ROC/Regression                  [org.nd4j.evaluation]
+- ``nlp``      — tokenizers, Word2Vec, BERT pipeline        [deeplearning4j-nlp]
+"""
+
+__version__ = "0.1.0"
+
+# Light import surface: heavy submodules are imported on first attribute access
+# so that `import deeplearning4j_tpu` stays cheap (reference analog: lazy
+# backend init in org.nd4j.linalg.factory.Nd4j.<clinit>).
+import importlib as _importlib
+
+_SUBMODULES = (
+    "ndarray",
+    "ops",
+    "autodiff",
+    "nn",
+    "data",
+    "models",
+    "parallel",
+    "kernels",
+    "eval",
+    "nlp",
+    "rng",
+    "listeners",
+    "serde",
+    "utils",
+    "common",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        mod = _importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_SUBMODULES))

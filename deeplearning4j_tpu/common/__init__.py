@@ -1,0 +1,4 @@
+from .dtypes import DataType, promote_types, to_jax, from_jax
+from .environment import Environment
+
+__all__ = ["DataType", "promote_types", "to_jax", "from_jax", "Environment"]

@@ -1,0 +1,134 @@
+"""Data-type system with nd4j promotion semantics.
+
+Reference: libnd4j ``array/DataType.h`` + ``array/DataTypeUtils.h`` (dtype
+promotion rules, `sd::DataType` enum) and nd4j-api
+``org.nd4j.linalg.api.buffer.DataType``. On TPU, ``BFLOAT16`` is first-class
+(SURVEY.md §2.9 N16: "bf16 first-class").
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """nd4j's public dtype enum (org.nd4j.linalg.api.buffer.DataType)."""
+
+    DOUBLE = "float64"
+    FLOAT = "float32"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    LONG = "int64"
+    INT = "int32"
+    SHORT = "int16"
+    BYTE = "int8"
+    UBYTE = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    BOOL = "bool"
+    # UTF8 / COMPRESSED deliberately excluded: no string tensors on the TPU
+    # compute path (documented divergence; reference only used UTF8 in ETL).
+
+    @property
+    def jax(self):
+        return jnp.dtype(self.value)
+
+    @property
+    def np(self):
+        return np.dtype(self.value) if self.value != "bfloat16" else jnp.bfloat16
+
+    def is_fp(self) -> bool:
+        return self in _FLOATS
+
+    def is_int(self) -> bool:
+        return self in _INTS
+
+    def is_signed(self) -> bool:
+        return self in _SIGNED
+
+    @property
+    def width(self) -> int:
+        """Bytes per element (DataTypeUtils::sizeOf)."""
+        return jnp.dtype(self.value).itemsize
+
+    def __repr__(self):  # match nd4j's terse enum printing
+        return self.name
+
+
+_FLOATS = {DataType.DOUBLE, DataType.FLOAT, DataType.HALF, DataType.BFLOAT16}
+_INTS = {
+    DataType.LONG,
+    DataType.INT,
+    DataType.SHORT,
+    DataType.BYTE,
+    DataType.UBYTE,
+    DataType.UINT16,
+    DataType.UINT32,
+    DataType.UINT64,
+}
+_SIGNED = _FLOATS | {DataType.LONG, DataType.INT, DataType.SHORT, DataType.BYTE}
+
+_JAX_TO_DT = {jnp.dtype(dt.value): dt for dt in DataType}
+
+# nd4j promotion ladder (DataTypeUtils::pickPairwiseResultType): float beats
+# int beats bool; within a family the wider type wins; HALF+BFLOAT16 -> FLOAT
+# (no common 16-bit superset).
+_FP_RANK = {
+    DataType.BFLOAT16: 1,
+    DataType.HALF: 1,
+    DataType.FLOAT: 2,
+    DataType.DOUBLE: 3,
+}
+_INT_RANK = {
+    DataType.BYTE: 1,
+    DataType.UBYTE: 1,
+    DataType.SHORT: 2,
+    DataType.UINT16: 2,
+    DataType.INT: 3,
+    DataType.UINT32: 3,
+    DataType.LONG: 4,
+    DataType.UINT64: 4,
+}
+
+
+def promote_types(a: DataType, b: DataType) -> DataType:
+    """Pairwise result type, nd4j rules (DataTypeUtils::pickPairwiseResultType)."""
+    if a == b:
+        return a
+    if a.is_fp() or b.is_fp():
+        fa, fb = (x for x in (a, b))
+        if a.is_fp() and b.is_fp():
+            if _FP_RANK[a] == _FP_RANK[b]:  # HALF vs BFLOAT16
+                return DataType.FLOAT
+            return a if _FP_RANK[a] > _FP_RANK[b] else b
+        return a if a.is_fp() else b
+    if a.is_int() or b.is_int():
+        if a.is_int() and b.is_int():
+            if _INT_RANK[a] == _INT_RANK[b]:  # signed/unsigned same width
+                return a if a.is_signed() else b
+            return a if _INT_RANK[a] > _INT_RANK[b] else b
+        return a if a.is_int() else b
+    return DataType.BOOL
+
+
+def to_jax(dt) -> "jnp.dtype":
+    """Accept DataType | str | np/jnp dtype -> jnp dtype."""
+    if isinstance(dt, DataType):
+        return dt.jax
+    if isinstance(dt, str):
+        try:
+            return DataType[dt.upper()].jax
+        except KeyError:
+            return jnp.dtype(dt)
+    return jnp.dtype(dt)
+
+
+def from_jax(dtype) -> DataType:
+    dt = _JAX_TO_DT.get(jnp.dtype(dtype))
+    if dt is None:
+        raise TypeError(f"unsupported dtype {dtype}")
+    return dt

@@ -1,0 +1,76 @@
+"""Global environment singleton + flag registry.
+
+Reference: libnd4j ``system/Environment.h`` (``sd::Environment`` — verbose /
+debug / profiling flags, max threads) and the scattered
+``ND4JEnvironmentVars`` / ``ND4JSystemProperties`` constants. Per SURVEY.md
+§5.6 the rebuild centralizes every runtime flag in ONE documented namespace
+(``TDL_*``) and makes the whole set dumpable at init.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field, fields
+
+
+@dataclass
+class _Flags:
+    # name -> (env var, default, parser)
+    verbose: bool = False            # TDL_VERBOSE — per-op logging
+    debug: bool = False              # TDL_DEBUG — shape/alloc logging
+    profiling: bool = False          # TDL_PROFILING — op timing collection
+    check_nan: bool = False          # TDL_CHECK_NAN — NaN panic after each op
+    check_inf: bool = False          # TDL_CHECK_INF — Inf panic after each op
+    default_float: str = "float32"   # TDL_DEFAULT_FLOAT — eager default dtype
+    matmul_precision: str = "bfloat16"  # TDL_MATMUL_PRECISION — bf16|float32|tf32
+    max_host_threads: int = 0        # TDL_MAX_HOST_THREADS — 0 = auto
+    eager_cache_size: int = 4096     # TDL_EAGER_CACHE_SIZE — compiled-op LRU cap
+    seed: int = 0                    # TDL_SEED — initial global RNG seed
+
+
+def _parse(val: str, like):
+    if isinstance(like, bool):
+        return val.lower() in ("1", "true", "yes", "on")
+    return type(like)(val)
+
+
+class Environment:
+    """Process-wide singleton mirroring ``sd::Environment::getInstance()``."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._flags = _Flags()
+        for f in fields(_Flags):
+            env_name = "TDL_" + f.name.upper()
+            if env_name in os.environ:
+                setattr(self._flags, f.name, _parse(os.environ[env_name], getattr(self._flags, f.name)))
+
+    @classmethod
+    def get_instance(cls) -> "Environment":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    def __getattr__(self, name):
+        try:
+            return getattr(self.__dict__["_flags"], name)
+        except AttributeError:
+            raise AttributeError(name) from None
+
+    def set(self, name: str, value) -> None:
+        if not hasattr(self._flags, name):
+            raise KeyError(f"unknown flag {name}; known: {self.dump()}")
+        setattr(self._flags, name, value)
+
+    def dump(self) -> dict:
+        """Every flag + current value (SURVEY.md §5.6: discoverable at init)."""
+        return {f.name: getattr(self._flags, f.name) for f in fields(_Flags)}
+
+
+def env() -> Environment:
+    return Environment.get_instance()

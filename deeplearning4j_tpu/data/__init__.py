@@ -1,0 +1,18 @@
+from .dataset import DataSet, MultiDataSet
+from .iterators import (
+    DataSetIterator,
+    ListDataSetIterator,
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+    MultiDataSetIterator,
+)
+
+__all__ = [
+    "DataSet",
+    "MultiDataSet",
+    "DataSetIterator",
+    "ListDataSetIterator",
+    "ArrayDataSetIterator",
+    "AsyncDataSetIterator",
+    "MultiDataSetIterator",
+]

@@ -1,0 +1,109 @@
+"""DataSet / MultiDataSet containers.
+
+Reference: nd4j-api ``org.nd4j.linalg.dataset.DataSet`` (features, labels,
+featuresMask, labelsMask) and ``MultiDataSet`` (lists of each). Arrays are
+host numpy until they hit the compiled step (host→HBM transfer happens once
+per batch at execute time, matching the async-prefetch design §2.4 C12).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def _to_np(x):
+    if x is None:
+        return None
+    if isinstance(x, np.ndarray):
+        return x
+    if hasattr(x, "numpy"):
+        return x.numpy()
+    return np.asarray(x)
+
+
+class DataSet:
+    def __init__(self, features=None, labels=None, features_mask=None, labels_mask=None):
+        self.features = _to_np(features)
+        self.labels = _to_np(labels)
+        self.features_mask = _to_np(features_mask)
+        self.labels_mask = _to_np(labels_mask)
+
+    def num_examples(self) -> int:
+        return 0 if self.features is None else self.features.shape[0]
+
+    def get_features(self):
+        return self.features
+
+    def get_labels(self):
+        return self.labels
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self.num_examples())
+        self.features = self.features[perm]
+        if self.labels is not None:
+            self.labels = self.labels[perm]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[perm]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[perm]
+        return self
+
+    def split_test_and_train(self, n_train: int):
+        a = DataSet(
+            self.features[:n_train],
+            None if self.labels is None else self.labels[:n_train],
+            None if self.features_mask is None else self.features_mask[:n_train],
+            None if self.labels_mask is None else self.labels_mask[:n_train],
+        )
+        b = DataSet(
+            self.features[n_train:],
+            None if self.labels is None else self.labels[n_train:],
+            None if self.features_mask is None else self.features_mask[n_train:],
+            None if self.labels_mask is None else self.labels_mask[n_train:],
+        )
+        return a, b
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        n = self.num_examples()
+        for i in range(0, n, batch_size):
+            out.append(
+                DataSet(
+                    self.features[i : i + batch_size],
+                    None if self.labels is None else self.labels[i : i + batch_size],
+                    None if self.features_mask is None else self.features_mask[i : i + batch_size],
+                    None if self.labels_mask is None else self.labels_mask[i : i + batch_size],
+                )
+            )
+        return out
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([d.features for d in datasets]),
+            np.concatenate([d.labels for d in datasets]) if datasets[0].labels is not None else None,
+            np.concatenate([d.features_mask for d in datasets]) if datasets[0].features_mask is not None else None,
+            np.concatenate([d.labels_mask for d in datasets]) if datasets[0].labels_mask is not None else None,
+        )
+
+    def __repr__(self):
+        f = None if self.features is None else self.features.shape
+        l = None if self.labels is None else self.labels.shape
+        return f"DataSet(features={f}, labels={l})"
+
+
+class MultiDataSet:
+    """org.nd4j.linalg.dataset.MultiDataSet: N features, M labels + masks."""
+
+    def __init__(self, features=None, labels=None, features_masks=None, labels_masks=None):
+        as_list = lambda x: None if x is None else [_to_np(a) for a in (x if isinstance(x, (list, tuple)) else [x])]
+        self.features = as_list(features) or []
+        self.labels = as_list(labels) or []
+        self.features_masks = as_list(features_masks)
+        self.labels_masks = as_list(labels_masks)
+
+    def num_examples(self) -> int:
+        return 0 if not self.features else self.features[0].shape[0]

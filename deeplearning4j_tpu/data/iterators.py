@@ -1,0 +1,211 @@
+"""DataSet iterators + async prefetch.
+
+Reference: nd4j ``org.nd4j.linalg.dataset.api.iterator.DataSetIterator`` SPI
+and deeplearning4j ``org.deeplearning4j.datasets.iterator.AsyncDataSetIterator``
+(background prefetch thread + bounded queue feeding ``fit``; SURVEY §2.4 C12,
+§3.2). The TPU analog keeps the same shape: a host thread stages upcoming
+batches so the accelerator step never waits on ETL.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import DataSet, MultiDataSet
+
+
+class DataSetIterator:
+    """Iterator SPI: next() -> DataSet, reset(), batch(), has_next()."""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def async_supported(self) -> bool:
+        return True
+
+    def reset_supported(self) -> bool:
+        return True
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """org.deeplearning4j.datasets.iterator.impl.ListDataSetIterator."""
+
+    def __init__(self, datasets: Sequence[DataSet], batch_size: Optional[int] = None):
+        if batch_size is not None:
+            merged = DataSet.merge(list(datasets)) if len(datasets) > 1 else datasets[0]
+            self._list = merged.batch_by(batch_size)
+            self._batch = batch_size
+        else:
+            self._list = list(datasets)
+            self._batch = self._list[0].num_examples() if self._list else 0
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._list)
+
+    def next(self) -> DataSet:
+        d = self._list[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self._batch
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batches over in-memory (features, labels) arrays, optional shuffle per
+    epoch (the common INDArray fit path)."""
+
+    def __init__(self, features, labels, batch_size: int, shuffle: bool = False, seed: int = 0, drop_last: bool = False):
+        self.features = np.asarray(features) if not hasattr(features, "numpy") else features.numpy()
+        self.labels = np.asarray(labels) if not hasattr(labels, "numpy") else labels.numpy()
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._seed = seed
+        self._drop_last = drop_last
+        self._order = np.arange(self.features.shape[0])
+        self._pos = 0
+        self._epoch = 0
+
+    def has_next(self) -> bool:
+        remaining = self.features.shape[0] - self._pos
+        return remaining >= (self.batch_size if self._drop_last else 1)
+
+    def next(self) -> DataSet:
+        ix = self._order[self._pos : self._pos + self.batch_size]
+        self._pos += self.batch_size
+        return DataSet(self.features[ix], self.labels[ix])
+
+    def reset(self) -> None:
+        self._pos = 0
+        self._epoch += 1
+        if self.shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            rng.shuffle(self._order)
+
+    def batch(self) -> int:
+        return self.batch_size
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (AsyncDataSetIterator parity):
+    bounded queue of ready batches; the training loop overlaps host ETL with
+    device execution. The reference pins prefetched buffers in workspaces; on
+    TPU the equivalent is simply keeping batches host-staged until dispatch."""
+
+    _END = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+        self._base = base
+        self._size = queue_size
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._next_item = None
+        self._exhausted = False
+
+    def _start(self):
+        """Lazy start: the worker spins up on first has_next()/next() so a
+        reset() before any consumption doesn't waste a full ETL pass."""
+        self._exhausted = False
+        self._queue = queue.Queue(maxsize=self._size)
+
+        def worker():
+            try:
+                while self._base.has_next():
+                    self._queue.put(self._base.next())
+            finally:
+                self._queue.put(self._END)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+        self._advance()
+
+    def _ensure_started(self):
+        if self._thread is None:
+            self._start()
+
+    def _advance(self):
+        item = self._queue.get()
+        if item is self._END:
+            self._exhausted = True
+            self._next_item = None
+        else:
+            self._next_item = item
+
+    def has_next(self) -> bool:
+        self._ensure_started()
+        return not self._exhausted
+
+    def next(self) -> DataSet:
+        self._ensure_started()
+        item = self._next_item
+        self._advance()
+        return item
+
+    def reset(self) -> None:
+        if self._thread is not None:
+            # drain so the worker can exit
+            while not self._exhausted:
+                self._advance()
+            self._thread.join()
+            self._thread = None
+        self._base.reset()
+
+    def batch(self) -> int:
+        return self._base.batch()
+
+
+class MultiDataSetIterator:
+    """api.iterator.MultiDataSetIterator SPI."""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> MultiDataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+
+class ListMultiDataSetIterator(MultiDataSetIterator):
+    def __init__(self, items: Sequence[MultiDataSet]):
+        self._items = list(items)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._items)
+
+    def next(self):
+        d = self._items[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self):
+        self._pos = 0

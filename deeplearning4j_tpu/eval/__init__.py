@@ -1,0 +1,3 @@
+from .evaluation import Evaluation, RegressionEvaluation, ROC, EvaluationBinary
+
+__all__ = ["Evaluation", "RegressionEvaluation", "ROC", "EvaluationBinary"]

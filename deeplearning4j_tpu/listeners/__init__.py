@@ -1,0 +1,19 @@
+from .listeners import (
+    TrainingListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    CheckpointListener,
+    TimeIterationListener,
+    CollectScoresIterationListener,
+    EvaluativeListener,
+)
+
+__all__ = [
+    "TrainingListener",
+    "ScoreIterationListener",
+    "PerformanceListener",
+    "CheckpointListener",
+    "TimeIterationListener",
+    "CollectScoresIterationListener",
+    "EvaluativeListener",
+]

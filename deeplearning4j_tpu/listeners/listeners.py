@@ -1,0 +1,157 @@
+"""Training listeners.
+
+Reference: deeplearning4j ``org.deeplearning4j.optimize.api.TrainingListener``
+SPI + ``org.deeplearning4j.optimize.listeners.*``: ``ScoreIterationListener``,
+``PerformanceListener`` (samples/sec, memory), ``CheckpointListener``
+(rotating saves), ``TimeIterationListener``, ``EvaluativeListener``,
+``CollectScoresIterationListener`` (SURVEY §2.4 C8).
+
+The network calls ``iteration_done(model, iteration, epoch)`` after each
+compiled step and ``on_epoch_end(model)`` per epoch — same hook shape as the
+reference (forward/backward sub-events are meaningless inside one fused XLA
+step, a documented divergence).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        pass
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Print score every N iterations (ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.n = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.n == 0:
+            logger.info("Score at iteration %d is %.6f", iteration, model.score())
+            print(f"Score at iteration {iteration} is {model.score():.6f}")
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput reporting (PerformanceListener: samples/sec, batches/sec,
+    iteration time). GC stats are meaningless here; reports host RSS instead."""
+
+    def __init__(self, frequency: int = 10, report_samples: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_samples = report_samples
+        self._last_time = None
+        self._last_iter = None
+        self.last_samples_per_sec = float("nan")
+
+    def iteration_done(self, model, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            batch = getattr(model, "last_batch_size", None)
+            ips = iters / dt if dt > 0 else float("nan")
+            msg = f"iteration {iteration}: {ips:.1f} iters/sec"
+            if batch:
+                self.last_samples_per_sec = ips * batch
+                msg += f", {self.last_samples_per_sec:.1f} samples/sec"
+            print(msg)
+            self._last_time, self._last_iter = now, iteration
+        elif self._last_time is None:
+            self._last_time, self._last_iter = now, iteration
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA printing (TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 50):
+        self.total = total_iterations
+        self.frequency = frequency
+        self._start = time.perf_counter()
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.perf_counter() - self._start
+            remaining = elapsed / iteration * (self.total - iteration)
+            print(f"iteration {iteration}/{self.total}, ETA {remaining:.0f}s")
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Capture (iteration, score) pairs for plotting."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score()))
+
+
+class CheckpointListener(TrainingListener):
+    """Rotating checkpoint saves (CheckpointListener.Builder: every N
+    iterations/epochs, keepLast(n))."""
+
+    def __init__(
+        self,
+        directory: str,
+        save_every_n_iterations: Optional[int] = None,
+        save_every_n_epochs: Optional[int] = None,
+        keep_last: int = 3,
+    ):
+        self.dir = directory
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.keep_last = keep_last
+        self._saved: deque = deque()
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, tag: str):
+        from ..serde.model_serializer import ModelSerializer
+
+        path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
+        ModelSerializer.write_model(model, path, save_updater=True)
+        self._saved.append(path)
+        while len(self._saved) > self.keep_last:
+            old = self._saved.popleft()
+            if os.path.exists(old):
+                os.remove(old)
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.every_iter and iteration % self.every_iter == 0 and iteration > 0:
+            self._save(model, f"iter_{iteration}")
+
+    def on_epoch_end(self, model):
+        if self.every_epoch and (model.epoch % self.every_epoch) == 0:
+            self._save(model, f"epoch_{model.epoch}")
+
+    def last_checkpoint(self) -> Optional[str]:
+        return self._saved[-1] if self._saved else None
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic held-out evaluation (EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency_epochs: int = 1):
+        self.iterator = iterator
+        self.frequency = max(1, frequency_epochs)
+        self.history: List[float] = []
+
+    def on_epoch_end(self, model):
+        if model.epoch % self.frequency == 0:
+            ev = model.evaluate(self.iterator)
+            self.history.append(ev.accuracy())
+            print(f"epoch {model.epoch}: eval accuracy {ev.accuracy():.4f}")

@@ -1,0 +1,150 @@
+"""Array creation factory — the ``Nd4j`` statics.
+
+Reference: nd4j-api ``org.nd4j.linalg.factory.Nd4j`` (creation methods,
+``Nd4j.rand/randn/zeros/ones/valueArrayOf/linspace/eye/concat/...``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtypes import DataType, to_jax
+from ..common.environment import env
+from .ndarray import NDArray, _unwrap
+
+
+def _default_float():
+    return to_jax(env().default_float)
+
+
+def array(data, dtype=None, order: str = "c") -> NDArray:
+    buf = jnp.asarray(data, dtype=to_jax(dtype) if dtype is not None else None)
+    if dtype is None and jnp.issubdtype(buf.dtype, jnp.floating) and buf.dtype == jnp.float64:
+        buf = buf.astype(_default_float())
+    return NDArray(buf, order=order)
+
+
+create = array
+
+
+def scalar(value, dtype=None) -> NDArray:
+    return array(value, dtype=dtype)
+
+
+def zeros(*shape, dtype=None, order: str = "c") -> NDArray:
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return NDArray(jnp.zeros(shape, dtype=to_jax(dtype) if dtype else _default_float()), order=order)
+
+
+def ones(*shape, dtype=None, order: str = "c") -> NDArray:
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return NDArray(jnp.ones(shape, dtype=to_jax(dtype) if dtype else _default_float()), order=order)
+
+
+def full(shape, value, dtype=None) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.full(tuple(shape), value, dtype=to_jax(dtype) if dtype else _default_float()))
+
+
+def value_array_of(shape, value, dtype=None) -> NDArray:
+    return full(shape, value, dtype)
+
+
+def empty(dtype=None) -> NDArray:
+    """nd4j empty array: zero elements (Nd4j.empty)."""
+    return NDArray(jnp.zeros((0,), dtype=to_jax(dtype) if dtype else _default_float()))
+
+
+def arange(start, stop=None, step=1, dtype=None) -> NDArray:
+    return NDArray(jnp.arange(start, stop, step, dtype=to_jax(dtype) if dtype else None))
+
+
+def linspace(start, stop, num, dtype=None) -> NDArray:
+    return NDArray(jnp.linspace(start, stop, num, dtype=to_jax(dtype) if dtype else _default_float()))
+
+
+def eye(n, dtype=None) -> NDArray:
+    return NDArray(jnp.eye(n, dtype=to_jax(dtype) if dtype else _default_float()))
+
+
+def rand(*shape, dtype=None, min=0.0, max=1.0) -> NDArray:
+    """Uniform [min,max) via the stateful global RNG (Nd4j.rand)."""
+    from ..rng.random import get_random
+
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return get_random().uniform(shape, minval=min, maxval=max, dtype=to_jax(dtype) if dtype else _default_float())
+
+
+def randn(*shape, dtype=None) -> NDArray:
+    from ..rng.random import get_random
+
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return get_random().normal(shape, dtype=to_jax(dtype) if dtype else _default_float())
+
+
+def concat(dim: int, *arrays) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (tuple, list)):
+        arrays = tuple(arrays[0])
+    return NDArray(jnp.concatenate([jnp.asarray(_unwrap(a)) for a in arrays], axis=dim))
+
+
+def stack(dim: int, *arrays) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (tuple, list)):
+        arrays = tuple(arrays[0])
+    return NDArray(jnp.stack([jnp.asarray(_unwrap(a)) for a in arrays], axis=dim))
+
+
+def vstack(*arrays) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (tuple, list)):
+        arrays = tuple(arrays[0])
+    return NDArray(jnp.vstack([jnp.asarray(_unwrap(a)) for a in arrays]))
+
+
+def hstack(*arrays) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (tuple, list)):
+        arrays = tuple(arrays[0])
+    return NDArray(jnp.hstack([jnp.asarray(_unwrap(a)) for a in arrays]))
+
+
+def where(cond, x=None, y=None) -> NDArray:
+    c = jnp.asarray(_unwrap(cond))
+    if x is None:
+        return NDArray(jnp.stack(jnp.nonzero(c), axis=-1))
+    return NDArray(jnp.where(c, jnp.asarray(_unwrap(x)), jnp.asarray(_unwrap(y))))
+
+
+def sort(arr, dim: int = -1, descending: bool = False) -> NDArray:
+    a = jnp.sort(jnp.asarray(_unwrap(arr)), axis=dim)
+    if descending:
+        a = jnp.flip(a, axis=dim)
+    return NDArray(a)
+
+
+def argsort(arr, dim: int = -1, descending: bool = False) -> NDArray:
+    a = jnp.argsort(jnp.asarray(_unwrap(arr)), axis=dim)
+    if descending:
+        a = jnp.flip(a, axis=dim)
+    return NDArray(a)
+
+
+def one_hot(indices, depth: int, dtype=None) -> NDArray:
+    ix = jnp.asarray(_unwrap(indices)).astype(jnp.int32)
+    out = (ix[..., None] == jnp.arange(depth)).astype(to_jax(dtype) if dtype else _default_float())
+    return NDArray(out)
+
+
+def diag(arr) -> NDArray:
+    return NDArray(jnp.diag(jnp.asarray(_unwrap(arr))))
+
+
+def pad(arr, pad_width, mode: str = "constant", constant_values=0) -> NDArray:
+    return NDArray(jnp.pad(jnp.asarray(_unwrap(arr)), pad_width, mode=mode,
+                           **({"constant_values": constant_values} if mode == "constant" else {})))

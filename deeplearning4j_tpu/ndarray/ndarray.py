@@ -1,0 +1,736 @@
+"""Eager INDArray-parity tensor.
+
+Reference: nd4j-api ``org.nd4j.linalg.api.ndarray.INDArray`` /
+``BaseNDArray`` (~700 methods: views, broadcasting arithmetic, in-place
+variants, 'c'/'f' ordering) backed by libnd4j ``array/NDArray.h``.
+
+TPU-native design (SURVEY.md §7.2 hard part #1): DL4J views alias storage and
+in-place ops mutate through views. XLA buffers are immutable, so:
+
+- an *owner* NDArray holds the current device buffer (``jax.Array``);
+- a *view* holds (root owner, per-dim basic index); reads slice the root's
+  current buffer lazily; writes route through ``buf.at[index].set`` on the
+  root, which every other view of the same root observes on next read.
+
+This preserves DL4J aliasing semantics exactly for basic (point/interval)
+indexing while every op remains a pure XLA computation (fusable, jittable).
+'c'/'f' order is logical metadata affecting reshape/ravel/dup semantics only —
+physical layout is XLA's concern on TPU (there is no user-visible stride).
+"""
+
+from __future__ import annotations
+
+import math
+import operator
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtypes import DataType, from_jax, promote_types, to_jax
+from ..common.environment import env
+
+Number = Union[int, float, bool]
+
+
+def _is_basic_index(ix) -> bool:
+    # None (newaxis) is deliberately excluded: a newaxis view cannot be
+    # composed against the root's dims for write-through, so it takes the
+    # copy path instead (nd4j newAxis views are read-mostly anyway).
+    if isinstance(ix, (int, np.integer, slice)) or ix is Ellipsis:
+        return True
+    if isinstance(ix, tuple):
+        return all(_is_basic_index(i) for i in ix)
+    return False
+
+
+def _slice_len(start: int, stop: int, step: int) -> int:
+    """Element count of a normalized slice (matches CPython's semantics)."""
+    if step > 0:
+        return max(0, (stop - start + step - 1) // step)
+    return max(0, (stop - start + step + 1) // step)
+
+
+def _compose_slice(outer: slice, inner, dim: int):
+    """Compose `inner` index applied to the result of `outer` slice of a dim."""
+    start, stop, step = outer.indices(dim)
+    n = _slice_len(start, stop, step)
+    if isinstance(inner, (int, np.integer)):
+        i = int(inner)
+        if i < 0:
+            i += n
+        if not (0 <= i < n):
+            raise IndexError(f"index {inner} out of bounds for view dim of size {n}")
+        return start + i * step
+    if isinstance(inner, slice):
+        i_start, i_stop, i_step = inner.indices(n)
+        new_start = start + i_start * step
+        new_step = step * i_step
+        count = _slice_len(i_start, i_stop, i_step)
+        new_stop = new_start + count * new_step
+        if new_step < 0 and new_stop < 0:
+            new_stop = None  # slice to the front inclusive of index 0
+        return slice(new_start, new_stop, new_step)
+    raise IndexError(f"unsupported sub-index {inner!r}")
+
+
+class NDArray:
+    """Mutable n-d array facade over immutable XLA buffers.
+
+    Owner: ``_root is None`` and ``_buf`` holds the device array.
+    View:  ``_root`` is the owner and ``_index`` the basic index into it.
+    """
+
+    __slots__ = ("_buf", "_root", "_index", "_order")
+    __array_priority__ = 100  # beat numpy in mixed expressions
+
+    def __init__(self, buf, order: str = "c", _root: "NDArray" = None, _index=None):
+        if _root is not None:
+            self._buf = None
+            self._root = _root
+            self._index = _index
+        else:
+            self._buf = buf if isinstance(buf, jax.Array) else jnp.asarray(buf)
+            self._root = None
+            self._index = None
+        self._order = order
+
+    # ------------------------------------------------------------------ core
+
+    @property
+    def jax(self) -> jax.Array:
+        """Current value as an immutable jax.Array (zero-copy for owners)."""
+        if self._root is None:
+            return self._buf
+        return self._root.jax[self._index]
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.jax)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def _set_value(self, new_buf) -> "NDArray":
+        """Route a full-value replacement through the root buffer (aliasing)."""
+        if self._root is None:
+            self._buf = new_buf if isinstance(new_buf, jax.Array) else jnp.asarray(new_buf)
+        else:
+            root = self._root
+            root._buf = root._buf.at[self._index].set(jnp.asarray(new_buf, root._buf.dtype))
+        return self
+
+    @property
+    def is_view(self) -> bool:
+        return self._root is not None
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        if self._root is None:
+            return tuple(self._buf.shape)
+        return tuple(jax.eval_shape(lambda b: b[self._index], self._root._buf).shape)
+
+    @property
+    def rank(self) -> int:
+        return len(self.shape)
+
+    @property
+    def length(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) if self.shape else 1
+
+    def size(self, dim: int) -> int:
+        return self.shape[dim]
+
+    @property
+    def data_type(self) -> DataType:
+        return from_jax(self.jax.dtype)
+
+    dtype = data_type
+
+    @property
+    def ordering(self) -> str:
+        return self._order
+
+    def is_scalar(self) -> bool:
+        return self.rank == 0 or self.length == 1 and self.rank <= 1
+
+    def is_vector(self) -> bool:
+        return self.rank == 1 or (self.rank == 2 and 1 in self.shape)
+
+    def is_row_vector(self) -> bool:
+        return self.rank == 1 or (self.rank == 2 and self.shape[0] == 1)
+
+    def is_column_vector(self) -> bool:
+        return self.rank == 2 and self.shape[1] == 1
+
+    def is_matrix(self) -> bool:
+        return self.rank == 2
+
+    def is_empty(self) -> bool:
+        return self.length == 0
+
+    # -------------------------------------------------------------- lifecycle
+
+    def dup(self, order: Optional[str] = None) -> "NDArray":
+        """Detached copy (BaseNDArray.dup). Order is logical metadata."""
+        return NDArray(self.jax, order=order or self._order)
+
+    def detach(self) -> "NDArray":
+        return self.dup()
+
+    def assign(self, other) -> "NDArray":
+        """In-place overwrite, broadcasting per nd4j assign semantics."""
+        val = _unwrap(other)
+        val = jnp.broadcast_to(jnp.asarray(val, self.jax.dtype), self.shape)
+        return self._set_value(val)
+
+    def cast_to(self, dt) -> "NDArray":
+        return NDArray(self.jax.astype(to_jax(dt)), order=self._order)
+
+    castTo = cast_to
+
+    # ------------------------------------------------------------ view/index
+
+    def __getitem__(self, ix) -> "NDArray":
+        if _is_basic_index(ix):
+            root = self if self._root is None else self._root
+            index = self._resolve_index(ix)
+            return NDArray(None, order=self._order, _root=root, _index=index)
+        # advanced indexing -> copy (matches nd4j get(INDArrayIndex) copy cases)
+        return NDArray(self.jax[_unwrap_index(ix)], order=self._order)
+
+    def _resolve_index(self, ix):
+        """Normalize `ix` against self, composing with an existing view index."""
+        if not isinstance(ix, tuple):
+            ix = (ix,)
+        if Ellipsis in ix:
+            pos = ix.index(Ellipsis)
+            fill = len(self.shape) - (len(ix) - 1 - sum(1 for i in ix if i is None))
+            ix = ix[:pos] + (slice(None),) * (fill - pos + sum(1 for i in ix[:pos] if i is None)) + ix[pos + 1:]
+        my_shape = self.shape
+        # pad to full rank
+        n_indexed = sum(1 for i in ix if i is not None)
+        ix = ix + (slice(None),) * (len(my_shape) - n_indexed)
+        if self._root is None:
+            return ix
+        # compose with existing view index (self._index indexes the root)
+        if any(i is None for i in ix):
+            raise IndexError("newaxis on a view is unsupported; use .dup() first")
+        base_index = self._index
+        composed = []
+        vi = 0  # position in ix (view dims)
+        root_shape = self._root.shape
+        for d, b in enumerate(base_index):
+            if isinstance(b, (int, np.integer)):
+                composed.append(b)  # dim already collapsed in view
+            else:
+                composed.append(_compose_slice(b if isinstance(b, slice) else slice(None), ix[vi], root_shape[d]))
+                vi += 1
+        # extra trailing dims of the root not covered by base_index
+        for d in range(len(base_index), len(root_shape)):
+            if vi < len(ix):
+                composed.append(ix[vi])
+                vi += 1
+            else:
+                composed.append(slice(None))
+        return tuple(composed)
+
+    def __setitem__(self, ix, value) -> None:
+        val = _unwrap(value)
+        if _is_basic_index(ix):
+            target = self[ix]
+            target.assign(val)
+        else:
+            root = self if self._root is None else self._root
+            if self._root is None:
+                self._buf = self._buf.at[_unwrap_index(ix)].set(jnp.asarray(val, self._buf.dtype))
+            else:
+                cur = self.jax.at[_unwrap_index(ix)].set(jnp.asarray(val, self.jax.dtype))
+                self._set_value(cur)
+
+    def get_scalar(self, *indices) -> "NDArray":
+        return self[tuple(int(i) for i in indices)]
+
+    def get_double(self, *indices) -> float:
+        return float(self.jax[tuple(int(i) for i in indices)])
+
+    def get_int(self, *indices) -> int:
+        return int(self.jax[tuple(int(i) for i in indices)])
+
+    def put_scalar(self, indices, value) -> "NDArray":
+        if isinstance(indices, (int, np.integer)):
+            indices = (indices,)
+        self[tuple(int(i) for i in indices)] = value
+        return self
+
+    putScalar = put_scalar
+
+    def get_row(self, i: int) -> "NDArray":
+        return self[i]
+
+    def get_column(self, i: int) -> "NDArray":
+        return self[:, i]
+
+    def get_rows(self, *rows) -> "NDArray":
+        return NDArray(self.jax[jnp.asarray(rows)], order=self._order)
+
+    def get_columns(self, *cols) -> "NDArray":
+        return NDArray(self.jax[:, jnp.asarray(cols)], order=self._order)
+
+    def put_row(self, i: int, row) -> "NDArray":
+        self[i] = row
+        return self
+
+    def put_column(self, i: int, col) -> "NDArray":
+        self[:, i] = col
+        return self
+
+    def tensor_along_dimension(self, index: int, *dims: int) -> "NDArray":
+        """TAD view (libnd4j helpers/TAD.h): the index-th sub-tensor spanning
+        `dims`, iterating the remaining dims in C order."""
+        dims = tuple(sorted(d % self.rank for d in dims))
+        iter_dims = [d for d in range(self.rank) if d not in dims]
+        iter_shape = [self.shape[d] for d in iter_dims]
+        coords = np.unravel_index(index, iter_shape) if iter_dims else ()
+        ix = [slice(None)] * self.rank
+        for d, c in zip(iter_dims, coords):
+            ix[d] = int(c)
+        return self[tuple(ix)]
+
+    def tensors_along_dimension(self, *dims: int) -> int:
+        dims = tuple(sorted(d % self.rank for d in dims))
+        n = 1
+        for d in range(self.rank):
+            if d not in dims:
+                n *= self.shape[d]
+        return n
+
+    # -------------------------------------------------------------- reshape
+
+    def reshape(self, *shape, order: Optional[str] = None) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(int(s) for s in shape)
+        order = order or self._order
+        buf = self.jax
+        if order == "f":
+            # F reshape == ravel in F order, fill in F order: reshape the
+            # F-raveled data to reversed(shape) in C order, then transpose.
+            out = jnp.reshape(_fravel(buf), shape[::-1]).transpose(tuple(reversed(range(len(shape)))))
+            return NDArray(out, order="f")
+        return NDArray(jnp.reshape(buf, shape), order="c")
+
+    def ravel(self, order: Optional[str] = None) -> "NDArray":
+        order = order or self._order
+        buf = self.jax
+        return NDArray(_fravel(buf) if order == "f" else jnp.ravel(buf), order=order)
+
+    def flatten(self, order: Optional[str] = None) -> "NDArray":
+        return self.ravel(order)
+
+    def transpose(self, *axes) -> "NDArray":
+        buf = self.jax
+        if not axes:
+            return NDArray(buf.T, order=self._order)
+        return self.permute(*axes)
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    def permute(self, *axes) -> "NDArray":
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return NDArray(jnp.transpose(self.jax, axes), order=self._order)
+
+    def permutei(self, *axes) -> "NDArray":
+        return self._set_self(self.permute(*axes))
+
+    def swap_axes(self, a: int, b: int) -> "NDArray":
+        return NDArray(jnp.swapaxes(self.jax, a, b), order=self._order)
+
+    def broadcast(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.broadcast_to(self.jax, shape), order=self._order)
+
+    def repeat(self, dim: int, repeats: int) -> "NDArray":
+        return NDArray(jnp.repeat(self.jax, repeats, axis=dim), order=self._order)
+
+    def tile(self, *reps) -> "NDArray":
+        return NDArray(jnp.tile(self.jax, reps), order=self._order)
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return NDArray(jnp.squeeze(self.jax, axis=axis), order=self._order)
+
+    def expand_dims(self, axis: int) -> "NDArray":
+        return NDArray(jnp.expand_dims(self.jax, axis), order=self._order)
+
+    def _set_self(self, other: "NDArray") -> "NDArray":
+        """In-place structural replace (permutei/reshapei on owners only)."""
+        if self._root is not None:
+            raise ValueError("in-place structural ops unsupported on views")
+        self._buf = other.jax
+        self._order = other._order
+        return self
+
+    # ----------------------------------------------------------- arithmetic
+
+    def _binary(self, other, fn, reverse=False) -> "NDArray":
+        a = self.jax
+        b = _unwrap(other)
+        if not isinstance(b, (int, float, bool)):
+            b = jnp.asarray(b)
+            rt = promote_types(from_jax(a.dtype), from_jax(b.dtype)).jax
+            a, b = a.astype(rt), b.astype(rt)
+        if reverse:
+            a, b = b, a
+        from ..ops.executioner import record_op
+
+        record_op(fn.__name__)
+        return NDArray(fn(a, b), order=self._order)
+
+    def _binary_i(self, other, fn, reverse=False) -> "NDArray":
+        out = self._binary(other, fn, reverse)
+        return self._set_value(out.jax.astype(self.jax.dtype))
+
+    # out-of-place
+    def add(self, o):
+        return self._binary(o, jnp.add)
+
+    def sub(self, o):
+        return self._binary(o, jnp.subtract)
+
+    def mul(self, o):
+        return self._binary(o, jnp.multiply)
+
+    def div(self, o):
+        return self._binary(o, jnp.divide)
+
+    def rsub(self, o):
+        return self._binary(o, jnp.subtract, reverse=True)
+
+    def rdiv(self, o):
+        return self._binary(o, jnp.divide, reverse=True)
+
+    def fmod(self, o):
+        return self._binary(o, jnp.fmod)
+
+    def pow(self, o):
+        return self._binary(o, jnp.power)
+
+    # in-place (addi/subi/… mutate through views — the DL4J contract)
+    def addi(self, o):
+        return self._binary_i(o, jnp.add)
+
+    def subi(self, o):
+        return self._binary_i(o, jnp.subtract)
+
+    def muli(self, o):
+        return self._binary_i(o, jnp.multiply)
+
+    def divi(self, o):
+        return self._binary_i(o, jnp.divide)
+
+    def rsubi(self, o):
+        return self._binary_i(o, jnp.subtract, reverse=True)
+
+    def rdivi(self, o):
+        return self._binary_i(o, jnp.divide, reverse=True)
+
+    def negi(self):
+        return self._set_value(-self.jax)
+
+    def neg(self):
+        return NDArray(-self.jax, order=self._order)
+
+    __add__ = add
+    __radd__ = add
+    __sub__ = sub
+    __rsub__ = rsub
+    __mul__ = mul
+    __rmul__ = mul
+    __truediv__ = div
+    __rtruediv__ = rdiv
+    __pow__ = pow
+    __mod__ = fmod
+    __neg__ = neg
+
+    def __iadd__(self, o):
+        return self.addi(o)
+
+    def __isub__(self, o):
+        return self.subi(o)
+
+    def __imul__(self, o):
+        return self.muli(o)
+
+    def __itruediv__(self, o):
+        return self.divi(o)
+
+    # comparisons -> BOOL arrays (nd4j gt/lt/eq return BOOL since beta4)
+    def gt(self, o):
+        return self._binary(o, jnp.greater)
+
+    def gte(self, o):
+        return self._binary(o, jnp.greater_equal)
+
+    def lt(self, o):
+        return self._binary(o, jnp.less)
+
+    def lte(self, o):
+        return self._binary(o, jnp.less_equal)
+
+    def eq(self, o):
+        return self._binary(o, jnp.equal)
+
+    def neq(self, o):
+        return self._binary(o, jnp.not_equal)
+
+    __gt__ = gt
+    __ge__ = gte
+    __lt__ = lt
+    __le__ = lte
+
+    def __eq__(self, o):  # nd4j: INDArray.eq is elementwise
+        return self.eq(o)
+
+    def __ne__(self, o):
+        return self.neq(o)
+
+    __hash__ = None
+
+    # row/column broadcast family (BaseNDArray.addRowVector etc.)
+    def _rowcol(self, vec, fn, axis) -> "NDArray":
+        v = jnp.asarray(_unwrap(vec)).ravel()
+        v = v.reshape((1, -1)) if axis == 1 else v.reshape((-1, 1))
+        return NDArray(fn(self.jax, v.astype(self.jax.dtype)), order=self._order)
+
+    def add_row_vector(self, v):
+        return self._rowcol(v, jnp.add, 1)
+
+    def sub_row_vector(self, v):
+        return self._rowcol(v, jnp.subtract, 1)
+
+    def mul_row_vector(self, v):
+        return self._rowcol(v, jnp.multiply, 1)
+
+    def div_row_vector(self, v):
+        return self._rowcol(v, jnp.divide, 1)
+
+    def add_column_vector(self, v):
+        return self._rowcol(v, jnp.add, 0)
+
+    def sub_column_vector(self, v):
+        return self._rowcol(v, jnp.subtract, 0)
+
+    def mul_column_vector(self, v):
+        return self._rowcol(v, jnp.multiply, 0)
+
+    def div_column_vector(self, v):
+        return self._rowcol(v, jnp.divide, 0)
+
+    def addi_row_vector(self, v):
+        return self._set_value(self.add_row_vector(v).jax)
+
+    def addi_column_vector(self, v):
+        return self._set_value(self.add_column_vector(v).jax)
+
+    def muli_row_vector(self, v):
+        return self._set_value(self.mul_row_vector(v).jax)
+
+    def muli_column_vector(self, v):
+        return self._set_value(self.mul_column_vector(v).jax)
+
+    # --------------------------------------------------------------- linalg
+
+    def mmul(self, other, transpose_a=False, transpose_b=False) -> "NDArray":
+        """Matrix multiply on the MXU (libnd4j MmulHelper::mmul → XLA
+        dot_general; batched ranks handled like mmulNxN)."""
+        a, b = self.jax, jnp.asarray(_unwrap(other))
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        from ..ops.executioner import record_op
+
+        record_op("mmul")
+        return NDArray(jnp.matmul(a, b), order=self._order)
+
+    def mmuli(self, other) -> "NDArray":
+        return self._set_value(self.mmul(other).jax)
+
+    def __matmul__(self, other):
+        return self.mmul(other)
+
+    def dot(self, other) -> float:
+        return float(jnp.vdot(self.jax, jnp.asarray(_unwrap(other))))
+
+    # ------------------------------------------------------------ reductions
+
+    def _reduce(self, fn, dims, keep_dims=False) -> Union["NDArray", float]:
+        from ..ops.executioner import record_op
+
+        record_op(fn.__name__)
+        axis = None if not dims else tuple(d % self.rank for d in dims)
+        out = fn(self.jax, axis=axis, keepdims=keep_dims)
+        return NDArray(out, order=self._order)
+
+    def sum(self, *dims, keep_dims=False):
+        return self._reduce(jnp.sum, dims, keep_dims)
+
+    def mean(self, *dims, keep_dims=False):
+        return self._reduce(jnp.mean, dims, keep_dims)
+
+    def prod(self, *dims, keep_dims=False):
+        return self._reduce(jnp.prod, dims, keep_dims)
+
+    def max(self, *dims, keep_dims=False):
+        return self._reduce(jnp.max, dims, keep_dims)
+
+    def min(self, *dims, keep_dims=False):
+        return self._reduce(jnp.min, dims, keep_dims)
+
+    def amax(self, *dims):
+        return self._reduce(lambda x, axis, keepdims: jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims), dims)
+
+    def amin(self, *dims):
+        return self._reduce(lambda x, axis, keepdims: jnp.min(jnp.abs(x), axis=axis, keepdims=keepdims), dims)
+
+    def std(self, *dims, bias_corrected=True):
+        ddof = 1 if bias_corrected else 0
+        return self._reduce(lambda x, axis, keepdims: jnp.std(x, axis=axis, ddof=ddof, keepdims=keepdims), dims)
+
+    def var(self, *dims, bias_corrected=True):
+        ddof = 1 if bias_corrected else 0
+        return self._reduce(lambda x, axis, keepdims: jnp.var(x, axis=axis, ddof=ddof, keepdims=keepdims), dims)
+
+    def norm1(self, *dims):
+        return self._reduce(lambda x, axis, keepdims: jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims), dims)
+
+    def norm2(self, *dims):
+        return self._reduce(
+            lambda x, axis, keepdims: jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims)), dims
+        )
+
+    def norm_max(self, *dims):
+        return self.amax(*dims)
+
+    def argmax(self, *dims) -> "NDArray":
+        axis = None if not dims else dims[0] % self.rank
+        return NDArray(jnp.argmax(self.jax, axis=axis), order=self._order)
+
+    def argmin(self, *dims) -> "NDArray":
+        axis = None if not dims else dims[0] % self.rank
+        return NDArray(jnp.argmin(self.jax, axis=axis), order=self._order)
+
+    def cumsum(self, dim: int) -> "NDArray":
+        return NDArray(jnp.cumsum(self.jax, axis=dim), order=self._order)
+
+    def cumprod(self, dim: int) -> "NDArray":
+        return NDArray(jnp.cumprod(self.jax, axis=dim), order=self._order)
+
+    def sum_number(self) -> float:
+        return float(jnp.sum(self.jax))
+
+    def mean_number(self) -> float:
+        return float(jnp.mean(self.jax))
+
+    def max_number(self) -> float:
+        return float(jnp.max(self.jax))
+
+    def min_number(self) -> float:
+        return float(jnp.min(self.jax))
+
+    def std_number(self, bias_corrected=True) -> float:
+        return float(jnp.std(self.jax, ddof=1 if bias_corrected else 0))
+
+    def var_number(self, bias_corrected=True) -> float:
+        return float(jnp.var(self.jax, ddof=1 if bias_corrected else 0))
+
+    def norm1_number(self) -> float:
+        return float(jnp.sum(jnp.abs(self.jax)))
+
+    def norm2_number(self) -> float:
+        return float(jnp.sqrt(jnp.sum(jnp.square(self.jax))))
+
+    def entropy_number(self) -> float:
+        p = self.jax
+        return float(-jnp.sum(p * jnp.log(p)))
+
+    # ----------------------------------------------------------- predicates
+
+    def equals_to(self, other, eps: float = 1e-5) -> bool:
+        o = jnp.asarray(_unwrap(other))
+        if tuple(o.shape) != self.shape:
+            return False
+        a = self.jax
+        if jnp.issubdtype(a.dtype, jnp.floating) or jnp.issubdtype(o.dtype, jnp.floating):
+            return bool(jnp.all(jnp.abs(a.astype(jnp.float32) - o.astype(jnp.float32)) <= eps))
+        return bool(jnp.all(a == o))
+
+    equalsTo = equals_to
+
+    def equal_shapes(self, other) -> bool:
+        return self.shape == tuple(jnp.asarray(_unwrap(other)).shape)
+
+    def any(self) -> bool:
+        return bool(jnp.any(self.jax))
+
+    def all(self) -> bool:
+        return bool(jnp.all(self.jax))
+
+    def is_nan(self) -> "NDArray":
+        return NDArray(jnp.isnan(self.jax), order=self._order)
+
+    def is_infinite(self) -> "NDArray":
+        return NDArray(jnp.isinf(self.jax), order=self._order)
+
+    # ------------------------------------------------------------------ misc
+
+    def __len__(self) -> int:
+        return self.shape[0] if self.rank else 1
+
+    def __float__(self) -> float:
+        return float(self.jax)
+
+    def __int__(self) -> int:
+        return int(self.jax)
+
+    def __bool__(self) -> bool:
+        if self.length != 1:
+            raise ValueError("truth value of a multi-element NDArray is ambiguous")
+        return bool(self.jax)
+
+    def __repr__(self) -> str:
+        return f"NDArray{list(self.shape)}:{self.data_type.name.lower()}\n{np.array2string(self.numpy(), precision=4, suppress_small=True)}"
+
+    def to_string_full(self) -> str:
+        return np.array2string(self.numpy(), threshold=np.inf)
+
+    # JAX interop: NDArray is a pytree leaf-like container
+    def block_until_ready(self) -> "NDArray":
+        j = self.jax
+        if hasattr(j, "block_until_ready"):
+            j.block_until_ready()
+        return self
+
+
+def _fravel(buf):
+    """Fortran-order ravel of a (logically C-stored) buffer."""
+    if buf.ndim <= 1:
+        return jnp.ravel(buf)
+    return jnp.ravel(jnp.transpose(buf, tuple(reversed(range(buf.ndim)))))
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x.jax
+    return x
+
+
+def _unwrap_index(ix):
+    if isinstance(ix, tuple):
+        return tuple(_unwrap(i) if isinstance(i, NDArray) else i for i in ix)
+    return _unwrap(ix) if isinstance(ix, NDArray) else ix

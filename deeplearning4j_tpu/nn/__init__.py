@@ -1,0 +1,17 @@
+from . import activations, losses, updaters, weights
+from .conf import NeuralNetConfiguration, MultiLayerConfiguration
+from .graph_conf import ComputationGraphConfiguration
+from .multilayer import MultiLayerNetwork
+from .graph import ComputationGraph
+
+__all__ = [
+    "activations",
+    "losses",
+    "updaters",
+    "weights",
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+    "ComputationGraphConfiguration",
+    "MultiLayerNetwork",
+    "ComputationGraph",
+]

@@ -1,0 +1,159 @@
+"""Activation functions.
+
+Reference: nd4j ``org.nd4j.linalg.activations.impl.*`` (20+ IActivation impls
+with forward + backprop). Here each activation is a pure jax function —
+backprop comes free from jax autodiff, so the reference's hand-written
+``backprop()`` twins are unnecessary (XLA fuses these into adjacent matmuls).
+Registry keyed by the nd4j ``Activation`` enum names for config parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jnp.ndarray], jnp.ndarray]
+
+_REGISTRY: Dict[str, Activation] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+def get(name) -> Activation:
+    """Resolve an activation by nd4j enum name (case-insensitive)."""
+    if callable(name):
+        return name
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+@register("identity")
+def identity(x):
+    return x
+
+
+@register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@register("leakyrelu")
+def leakyrelu(x, alpha=0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register("elu")
+def elu(x):
+    return jax.nn.elu(x)
+
+
+@register("selu")
+def selu(x):
+    return jax.nn.selu(x)
+
+
+@register("gelu")
+def gelu(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+@register("precisegelu")
+def precise_gelu(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+@register("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register("rationaltanh")
+def rationaltanh(x):
+    # nd4j RationalTanh: 1.7159 * tanh(2x/3) approximation family
+    a = jnp.abs(2.0 * x / 3.0)
+    approx = jnp.sign(x) * (1.0 - 1.0 / jnp.square(1.0 + a + a * a + 1.41645 * a ** 4))
+    return 1.7159 * approx
+
+
+@register("rectifiedtanh")
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+@register("hardtanh")
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register("hardsigmoid")
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@register("softmax")
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("logsoftmax")
+def logsoftmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+@register("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@register("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register("swish")
+def swish(x):
+    return jax.nn.swish(x)
+
+
+@register("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register("cube")
+def cube(x):
+    return x ** 3
+
+
+@register("thresholdedrelu")
+def thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+def prelu(x, alpha):
+    """Parametric ReLU (learned alpha — used by PReLULayer)."""
+    return jnp.where(x >= 0, x, alpha * x)

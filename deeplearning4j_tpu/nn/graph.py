@@ -1,0 +1,260 @@
+"""ComputationGraph — DAG runtime.
+
+Reference: ``org.deeplearning4j.nn.graph.ComputationGraph`` (~4.8k LoC):
+topo-sorted GraphVertex[] execution, multi-input/multi-output, flat params.
+TPU-native: the whole DAG (all vertices, all output losses, updater) traces
+into ONE jit-compiled step, same as MultiLayerNetwork.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtypes import to_jax
+from ..data.dataset import DataSet, MultiDataSet
+from ..eval.evaluation import Evaluation
+from ..ndarray.ndarray import NDArray
+from .conf import BatchNormalization, GlobalPoolingLayer, LastTimeStep, LSTM, GravesLSTM
+from .graph_conf import ComputationGraphConfiguration
+from .multilayer import _grad_normalize
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params_: Dict[str, Any] = {}
+        self.bn_state: Dict[str, Any] = {}
+        self.updater_state: Dict[str, Any] = {}
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[Any] = []
+        self.score_ = float("nan")
+        self._dtype = to_jax(conf.dtype)
+        self._topo = conf.topo_order()
+        self._types = conf.infer_types()  # output type per node
+        self._in_types = self._compute_in_types()
+        self._jit_cache: Dict[str, Any] = {}
+
+    def _compute_in_types(self):
+        """Input InputType per node AFTER its preprocessor."""
+        types = dict(self.conf.input_types)
+        types.update(self._types)
+        in_types = {}
+        for name in self._topo:
+            node = self.conf.nodes[name]
+            its = [types[i] for i in node.inputs]
+            it = its[0] if its else None
+            if node.preprocessor is not None:
+                it = node.preprocessor.output_type(it)
+            in_types[name] = it
+        return in_types
+
+    def init(self) -> "ComputationGraph":
+        key = jax.random.key(self.conf.seed)
+        for name in self._topo:
+            node = self.conf.nodes[name]
+            if node.layer is not None and node.layer.has_params():
+                key, sub = jax.random.split(key)
+                self.params_[name] = node.layer.init_params(sub, self._in_types[name], self._dtype)
+            if isinstance(node.layer, BatchNormalization):
+                self.bn_state[name] = node.layer.init_state(self._in_types[name], self._dtype)
+        self.updater_state = self.conf.updater.init(self.params_)
+        return self
+
+    # -------------------------------------------------------------- forward
+
+    def _forward(self, params, bn_state, inputs: Dict[str, jnp.ndarray], *, training, rng, stop_at_loss=False,
+                 labels: Optional[Dict[str, jnp.ndarray]] = None, lmasks=None, fmask=None):
+        """Evaluate DAG. If labels given, returns (total_loss, new_bn); else
+        returns ({output_name: activation}, new_bn)."""
+        acts: Dict[str, jnp.ndarray] = dict(inputs)
+        new_bn = dict(bn_state)
+        total_loss = 0.0
+        for idx, name in enumerate(self._topo):
+            node = self.conf.nodes[name]
+            xs = [acts[i] for i in node.inputs]
+            if node.preprocessor is not None:
+                xs = [node.preprocessor.pre_process(xs[0], None)] + xs[1:]
+            sub = jax.random.fold_in(rng, idx) if rng is not None else None
+            if node.vertex is not None:
+                acts[name] = node.vertex.apply(xs)
+                continue
+            layer = node.layer
+            p = params.get(name, {})
+            it = self._in_types[name]
+            is_output = name in self.conf.network_outputs and hasattr(layer, "compute_loss")
+            if labels is not None and is_output:
+                y = labels[name]
+                lm = lmasks.get(name) if lmasks else None
+                total_loss = total_loss + layer.compute_loss(p, xs[0], y, it, training=training, rng=sub, mask=lm)
+                continue
+            if isinstance(layer, BatchNormalization):
+                out, nb = layer.forward_bn(p, new_bn[name], xs[0], it, training=training)
+                new_bn[name] = nb
+                acts[name] = out
+            elif isinstance(layer, (LastTimeStep, GlobalPoolingLayer)):
+                acts[name] = layer.forward(p, xs[0], it, training=training, rng=sub, mask=fmask)
+            else:
+                acts[name] = layer.forward(p, xs[0], it, training=training, rng=sub)
+        if labels is not None:
+            # L1/L2 regularization
+            reg = 0.0
+            for name, node in self.conf.nodes.items():
+                pj = params.get(name)
+                if not pj or node.layer is None:
+                    continue
+                if node.layer.l2 > 0.0:
+                    reg = reg + node.layer.l2 * 0.5 * sum(
+                        jnp.sum(jnp.square(w)) for k, w in pj.items() if k != "b"
+                    )
+                if node.layer.l1 > 0.0:
+                    reg = reg + node.layer.l1 * sum(jnp.sum(jnp.abs(w)) for k, w in pj.items() if k != "b")
+            return total_loss + reg, new_bn
+        return {o: acts[o] for o in self.conf.network_outputs}, new_bn
+
+    # ------------------------------------------------------------------- fit
+
+    def _train_step_fn(self):
+        if "train" in self._jit_cache:
+            return self._jit_cache["train"]
+        updater = self.conf.updater
+        gn, gnt = self.conf.gradient_normalization, self.conf.gradient_normalization_threshold
+
+        def step(params, upd_state, bn_state, iteration, epoch, inputs, labels, lmasks, rng):
+            def loss_fn(p):
+                return self._forward(p, bn_state, inputs, training=True, rng=rng, labels=labels, lmasks=lmasks)
+
+            (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = _grad_normalize(grads, gn, gnt)
+            updates, new_upd = updater.apply(grads, upd_state, params, iteration, epoch)
+            new_params = jax.tree.map(lambda p, u: p - u, params, updates)
+            return new_params, new_upd, new_bn, loss
+
+        self._jit_cache["train"] = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._jit_cache["train"]
+
+    def _coerce_inputs(self, features) -> Dict[str, jnp.ndarray]:
+        if isinstance(features, dict):
+            return {k: jnp.asarray(v, self._dtype) for k, v in features.items()}
+        if not isinstance(features, (list, tuple)):
+            features = [features]
+        return {
+            name: jnp.asarray(f.numpy() if hasattr(f, "numpy") else f, self._dtype)
+            for name, f in zip(self.conf.network_inputs, features)
+        }
+
+    def _coerce_labels(self, labels) -> Dict[str, jnp.ndarray]:
+        out_layers = [n for n in self.conf.network_outputs]
+        if isinstance(labels, dict):
+            return {k: jnp.asarray(v) for k, v in labels.items()}
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        return {name: jnp.asarray(l.numpy() if hasattr(l, "numpy") else l) for name, l in zip(out_layers, labels)}
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        """fit(DataSet/MultiDataSet/iterator) or fit(features, labels)."""
+        for _ in range(epochs):
+            if hasattr(data, "__iter__") and not isinstance(data, (DataSet, MultiDataSet, np.ndarray, list, tuple, dict)):
+                for ds in data:
+                    self._fit_one(ds)
+            elif isinstance(data, (DataSet, MultiDataSet)):
+                self._fit_one(data)
+            else:
+                self._fit_batch(self._coerce_inputs(data), self._coerce_labels(labels), None)
+            self.epoch += 1
+        return self
+
+    def _fit_one(self, ds):
+        if isinstance(ds, DataSet):
+            inputs = self._coerce_inputs([ds.features])
+            labels = self._coerce_labels([ds.labels])
+            lmasks = {self.conf.network_outputs[0]: jnp.asarray(ds.labels_mask)} if ds.labels_mask is not None else None
+        else:
+            inputs = self._coerce_inputs(list(ds.features))
+            labels = self._coerce_labels(list(ds.labels))
+            lmasks = (
+                {n: jnp.asarray(m) for n, m in zip(self.conf.network_outputs, ds.labels_masks)}
+                if ds.labels_masks
+                else None
+            )
+        self._fit_batch(inputs, labels, lmasks)
+
+    def _fit_batch(self, inputs, labels, lmasks):
+        step = self._train_step_fn()
+        rng = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self.iteration)
+        self.params_, self.updater_state, self.bn_state, loss = step(
+            self.params_, self.updater_state, self.bn_state,
+            jnp.asarray(self.iteration, jnp.int32), jnp.asarray(self.epoch, jnp.int32),
+            inputs, labels, lmasks, rng,
+        )
+        self.score_ = float(loss)
+        self.iteration += 1
+        for lst in self.listeners:
+            if hasattr(lst, "iteration_done"):
+                lst.iteration_done(self, self.iteration, self.epoch)
+
+    # --------------------------------------------------------------- output
+
+    def output(self, *features) -> List[NDArray]:
+        if "output" not in self._jit_cache:
+            def fwd(params, bn_state, inputs):
+                outs, _ = self._forward(params, bn_state, inputs, training=False, rng=None)
+                return outs
+
+            self._jit_cache["output"] = jax.jit(fwd)
+        inputs = self._coerce_inputs(list(features) if len(features) > 1 else features[0])
+        outs = self._jit_cache["output"](self.params_, self.bn_state, inputs)
+        return [NDArray(outs[o]) for o in self.conf.network_outputs]
+
+    def output_single(self, features) -> NDArray:
+        return self.output(features)[0]
+
+    def score(self, ds: Optional[DataSet] = None) -> float:
+        if ds is None:
+            return self.score_
+        inputs = self._coerce_inputs([ds.features] if isinstance(ds, DataSet) else list(ds.features))
+        labels = self._coerce_labels([ds.labels] if isinstance(ds, DataSet) else list(ds.labels))
+        loss, _ = self._forward(self.params_, self.bn_state, inputs, training=False, rng=None, labels=labels)
+        return float(loss)
+
+    def evaluate(self, iterator) -> Evaluation:
+        ev = Evaluation()
+        for ds in iterator:
+            preds = self.output_single(ds.features)
+            ev.eval(ds.labels, preds.numpy(), mask=ds.labels_mask)
+        return ev
+
+    # --------------------------------------------------------- params flat view
+
+    def _param_entries(self):
+        for name in self._topo:
+            if name in self.params_:
+                for pname in sorted(self.params_[name]):
+                    yield name, pname, self.params_[name][pname]
+
+    def params(self) -> NDArray:
+        chunks = [jnp.asarray(w).reshape(-1) for _, _, w in self._param_entries()]
+        return NDArray(jnp.concatenate(chunks) if chunks else jnp.zeros((0,)))
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(w.shape)) for _, _, w in self._param_entries())
+
+    def set_params(self, flat) -> None:
+        arr = np.asarray(flat.numpy() if hasattr(flat, "numpy") else flat).reshape(-1)
+        expected = self.num_params()
+        if arr.size != expected:
+            raise ValueError(f"param vector length {arr.size} != model numParams {expected}")
+        off = 0
+        new = {k: dict(v) for k, v in self.params_.items()}
+        for name, pname, w in self._param_entries():
+            n = int(np.prod(w.shape))
+            new[name][pname] = jnp.asarray(arr[off : off + n].reshape(w.shape), w.dtype)
+            off += n
+        self.params_ = new
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)

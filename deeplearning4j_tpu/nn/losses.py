@@ -1,0 +1,187 @@
+"""Loss functions.
+
+Reference: nd4j ``org.nd4j.linalg.lossfunctions.impl.*`` (15+ ILossFunction
+impls: computeScore/computeGradient, per-example mask + weight support).
+Each loss here is ``loss(labels, preds, mask=None, weights=None) -> scalar``
+(mean over examples, matching nd4j's scoreArray→average contract); gradients
+come from jax autodiff. Registry keyed by nd4j ``LossFunctions.LossFunction``
+enum names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Loss = Callable
+
+_REGISTRY: Dict[str, Loss] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+def get(name) -> Loss:
+    if callable(name):
+        return name
+    try:
+        return _REGISTRY[name.lower().replace("_", "")]
+    except KeyError:
+        raise ValueError(f"unknown loss {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+def _per_example_mean(per_elem, mask, weights):
+    """nd4j contract: sum over output dims -> per-example score; mask zeroes
+    examples/timesteps; weights scale per-output; final score = mean over
+    unmasked examples (or example-timesteps for a [B,T] mask)."""
+    if weights is not None:
+        per_elem = per_elem * weights
+    if mask is not None:
+        m = mask
+        # trailing singleton dims on the mask ([B,1] etc.) collapse first
+        while m.ndim > 1 and m.shape[-1] == 1 and m.ndim > per_elem.ndim - 1:
+            m = jnp.squeeze(m, axis=-1)
+        # reduce per_elem over every dim beyond the mask's rank ([B] mask over
+        # [B,C] preds; [B,T] mask over [B,T,C] time-distributed preds)
+        axes = tuple(range(m.ndim, per_elem.ndim))
+        per_unit = jnp.sum(per_elem, axis=axes) if axes else per_elem
+        m = m.astype(per_unit.dtype)
+        return jnp.sum(per_unit * m) / jnp.maximum(jnp.sum(m), 1.0)
+    axes = tuple(range(1, per_elem.ndim))
+    per_example = jnp.sum(per_elem, axis=axes) if axes else per_elem
+    return jnp.mean(per_example)
+
+
+@register("mse")
+def mse(labels, preds, mask=None, weights=None):
+    return _per_example_mean(jnp.square(preds - labels), mask, weights)
+
+
+@register("l2")
+def l2(labels, preds, mask=None, weights=None):
+    # nd4j L2 = sum of squares (no mean over outputs), per-example mean overall
+    return _per_example_mean(jnp.square(preds - labels), mask, weights)
+
+
+@register("mae")
+def mae(labels, preds, mask=None, weights=None):
+    return _per_example_mean(jnp.abs(preds - labels), mask, weights)
+
+
+@register("l1")
+def l1(labels, preds, mask=None, weights=None):
+    return _per_example_mean(jnp.abs(preds - labels), mask, weights)
+
+
+@register("xent")
+def xent(labels, preds, mask=None, weights=None):
+    """Binary cross-entropy on probabilities (LossBinaryXENT)."""
+    eps = 1e-7
+    p = jnp.clip(preds, eps, 1 - eps)
+    ce = -(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
+    return _per_example_mean(ce, mask, weights)
+
+
+@register("mcxent")
+def mcxent(labels, preds, mask=None, weights=None):
+    """Multi-class cross-entropy on probabilities (LossMCXENT); labels one-hot."""
+    eps = 1e-7
+    ce = -labels * jnp.log(jnp.clip(preds, eps, 1.0))
+    return _per_example_mean(ce, mask, weights)
+
+
+@register("sparsemcxent")
+def sparse_mcxent(labels, preds, mask=None, weights=None):
+    """Integer-label variant (LossSparseMCXENT)."""
+    eps = 1e-7
+    logp = jnp.log(jnp.clip(preds, eps, 1.0))
+    ce = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if mask is not None:
+        m = mask.astype(ce.dtype)
+        while m.ndim > ce.ndim:
+            m = jnp.squeeze(m, -1)
+        return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(ce)
+
+
+@register("negativeloglikelihood")
+def negativeloglikelihood(labels, preds, mask=None, weights=None):
+    return mcxent(labels, preds, mask, weights)
+
+
+@register("kldivergence")
+def kl_divergence(labels, preds, mask=None, weights=None):
+    eps = 1e-7
+    kl = labels * (jnp.log(jnp.clip(labels, eps, 1.0)) - jnp.log(jnp.clip(preds, eps, 1.0)))
+    return _per_example_mean(kl, mask, weights)
+
+
+@register("hinge")
+def hinge(labels, preds, mask=None, weights=None):
+    # labels in {-1, +1}
+    return _per_example_mean(jnp.maximum(0.0, 1.0 - labels * preds), mask, weights)
+
+
+@register("squaredhinge")
+def squared_hinge(labels, preds, mask=None, weights=None):
+    return _per_example_mean(jnp.square(jnp.maximum(0.0, 1.0 - labels * preds)), mask, weights)
+
+
+@register("poisson")
+def poisson(labels, preds, mask=None, weights=None):
+    eps = 1e-7
+    return _per_example_mean(preds - labels * jnp.log(jnp.clip(preds, eps, None)), mask, weights)
+
+
+@register("cosineproximity")
+def cosine_proximity(labels, preds, mask=None, weights=None):
+    ln = labels / jnp.maximum(jnp.linalg.norm(labels, axis=-1, keepdims=True), 1e-8)
+    pn = preds / jnp.maximum(jnp.linalg.norm(preds, axis=-1, keepdims=True), 1e-8)
+    return _per_example_mean(-ln * pn, mask, weights)
+
+
+@register("meansquaredlogarithmicerror")
+def msle(labels, preds, mask=None, weights=None):
+    return _per_example_mean(jnp.square(jnp.log1p(jnp.maximum(preds, -0.999999)) - jnp.log1p(labels)), mask, weights)
+
+
+@register("meanabsolutepercentageerror")
+def mape(labels, preds, mask=None, weights=None):
+    return _per_example_mean(100.0 * jnp.abs((labels - preds) / jnp.maximum(jnp.abs(labels), 1e-8)), mask, weights)
+
+
+@register("huber")
+def huber(labels, preds, mask=None, weights=None, delta: float = 1.0):
+    err = jnp.abs(preds - labels)
+    quad = jnp.minimum(err, delta)
+    return _per_example_mean(0.5 * quad ** 2 + delta * (err - quad), mask, weights)
+
+
+@register("wasserstein")
+def wasserstein(labels, preds, mask=None, weights=None):
+    return _per_example_mean(labels * preds, mask, weights)
+
+
+def softmax_cross_entropy_with_logits(labels, logits, mask=None, weights=None):
+    """Numerically-stable fused path (libnd4j generic/loss/
+    softmax_cross_entropy_loss.cpp); preferred internally by OutputLayer when
+    activation=softmax + loss=mcxent."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -labels * logp
+    return _per_example_mean(ce, mask, weights)
+
+
+def sigmoid_cross_entropy_with_logits(labels, logits, mask=None, weights=None):
+    ce = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _per_example_mean(ce, mask, weights)

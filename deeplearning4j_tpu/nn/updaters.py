@@ -1,0 +1,379 @@
+"""Gradient updaters + learning-rate schedules.
+
+Reference: nd4j ``org.nd4j.linalg.learning.config.*`` (IUpdater config beans:
+Adam, Nesterovs, RmsProp, AdaGrad, AdaDelta, Nadam, AMSGrad, AdaMax, Sgd,
+NoOp) ↔ ``org.nd4j.linalg.learning.*Updater`` impls operating on a flat state
+view, and ``org.nd4j.linalg.schedule.*`` (ISchedule impls).
+
+TPU-native: each updater is a pure function over pytrees —
+``init(params) -> state`` and ``apply(grads, state, params, iter) ->
+(updates, state)`` — applied inside the single compiled train step (the
+reference's UpdaterBlock fusion over the flat param vector is subsumed by XLA
+fusing the whole update). The config beans keep nd4j names/fields for JSON
+round-trip parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# ------------------------------------------------------------------ schedules
+
+
+@dataclass
+class Schedule:
+    """ISchedule: value(iteration, epoch) -> lr."""
+
+    def value(self, iteration, epoch):
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@class"] = type(self).__name__
+        return d
+
+
+@dataclass
+class FixedSchedule(Schedule):
+    value_: float
+
+    def value(self, iteration, epoch):
+        return self.value_
+
+
+@dataclass
+class ExponentialSchedule(Schedule):
+    """lr = initial * gamma^iter (org.nd4j.linalg.schedule.ExponentialSchedule)."""
+
+    initial_value: float
+    gamma: float
+    schedule_type: str = "ITERATION"  # ITERATION | EPOCH
+
+    def value(self, iteration, epoch):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        return self.initial_value * self.gamma ** t
+
+
+@dataclass
+class InverseSchedule(Schedule):
+    initial_value: float
+    gamma: float
+    power: float
+    schedule_type: str = "ITERATION"
+
+    def value(self, iteration, epoch):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        return self.initial_value / (1 + self.gamma * t) ** self.power
+
+
+@dataclass
+class StepSchedule(Schedule):
+    initial_value: float
+    decay_rate: float
+    step: float
+    schedule_type: str = "ITERATION"
+
+    def value(self, iteration, epoch):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        return self.initial_value * self.decay_rate ** jnp.floor(t / self.step)
+
+
+@dataclass
+class PolySchedule(Schedule):
+    initial_value: float
+    power: float
+    max_iter: int
+    schedule_type: str = "ITERATION"
+
+    def value(self, iteration, epoch):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        return self.initial_value * (1 - jnp.minimum(t, self.max_iter) / self.max_iter) ** self.power
+
+
+@dataclass
+class SigmoidSchedule(Schedule):
+    initial_value: float
+    gamma: float
+    step_size: int
+    schedule_type: str = "ITERATION"
+
+    def value(self, iteration, epoch):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        return self.initial_value / (1 + jnp.exp(self.gamma * (t - self.step_size)))
+
+
+@dataclass
+class WarmupLinearDecay(Schedule):
+    """Transformer-style warmup→linear-decay (no reference twin; needed for
+    BERT fine-tune config #5)."""
+
+    peak: float
+    warmup_steps: int
+    total_steps: int
+
+    def value(self, iteration, epoch):
+        it = jnp.asarray(iteration, jnp.float32)
+        warm = self.peak * it / jnp.maximum(self.warmup_steps, 1)
+        decay = self.peak * jnp.maximum(0.0, (self.total_steps - it)) / jnp.maximum(
+            self.total_steps - self.warmup_steps, 1
+        )
+        return jnp.where(it < self.warmup_steps, warm, decay)
+
+
+def _lr(updater, iteration, epoch):
+    if updater.lr_schedule is not None:
+        return updater.lr_schedule.value(iteration, epoch)
+    return updater.learning_rate
+
+
+# ------------------------------------------------------------------- updaters
+
+
+@dataclass
+class IUpdater:
+    """Base config bean; subclasses mirror nd4j field names and defaults.
+    ``lr_schedule`` is keyword-only so positional construction matches nd4j
+    (e.g. ``Nesterovs(lr, momentum)``)."""
+
+    learning_rate: float = 1e-3
+    lr_schedule: Optional[Schedule] = dataclasses.field(default=None, kw_only=True)
+
+    # pure-functional contract -------------------------------------------------
+    def init(self, params):
+        """State pytree for `params` (flat-view equivalent of legacy stateSize)."""
+        return {}
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        """Return (updates_to_subtract, new_state)."""
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        d = {k: v for k, v in dataclasses.asdict(self).items() if not isinstance(v, dict)}
+        if self.lr_schedule is not None:
+            d["lr_schedule"] = self.lr_schedule.to_json()
+        d["@class"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "IUpdater":
+        d = dict(d)
+        cls = _UPDATERS[d.pop("@class")]
+        sched = d.pop("lr_schedule", None)
+        if sched:
+            sd = dict(sched)
+            scls = _SCHEDULES[sd.pop("@class")]
+            d["lr_schedule"] = scls(**sd)
+        return cls(**d)
+
+
+@dataclass
+class NoOp(IUpdater):
+    def apply(self, grads, state, params, iteration, epoch=0):
+        return jax.tree.map(jnp.zeros_like, grads), state
+
+
+@dataclass
+class Sgd(IUpdater):
+    learning_rate: float = 1e-1
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        lr = _lr(self, iteration, epoch)
+        return jax.tree.map(lambda g: lr * g, grads), state
+
+
+@dataclass
+class Nesterovs(IUpdater):
+    """org.nd4j.linalg.learning.NesterovsUpdater: v = mu*v - lr*g;
+    update = -(mu*v_new - (1+mu)*... ) — DL4J uses the 'lookahead' form."""
+
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+
+    def init(self, params):
+        return {"v": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        lr = _lr(self, iteration, epoch)
+        mu = self.momentum
+        v_new = jax.tree.map(lambda v, g: mu * v - lr * g, state["v"], grads)
+        # DL4J Nesterov: update = -(mu * v_new - lr * g)  (applied as params += )
+        updates = jax.tree.map(lambda v, g: -(mu * v - lr * g), v_new, grads)
+        return updates, {"v": v_new}
+
+
+@dataclass
+class AdaGrad(IUpdater):
+    learning_rate: float = 1e-1
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"h": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        lr = _lr(self, iteration, epoch)
+        h = jax.tree.map(lambda h, g: h + g * g, state["h"], grads)
+        updates = jax.tree.map(lambda h, g: lr * g / (jnp.sqrt(h) + self.epsilon), h, grads)
+        return updates, {"h": h}
+
+
+@dataclass
+class RmsProp(IUpdater):
+    learning_rate: float = 1e-1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"g2": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        lr = _lr(self, iteration, epoch)
+        d = self.rms_decay
+        g2 = jax.tree.map(lambda a, g: d * a + (1 - d) * g * g, state["g2"], grads)
+        updates = jax.tree.map(lambda a, g: lr * g / (jnp.sqrt(a) + self.epsilon), g2, grads)
+        return updates, {"g2": g2}
+
+
+@dataclass
+class AdaDelta(IUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        z = jax.tree.map(jnp.zeros_like, params)
+        return {"msg": z, "msdx": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        rho, eps = self.rho, self.epsilon
+        msg = jax.tree.map(lambda a, g: rho * a + (1 - rho) * g * g, state["msg"], grads)
+        updates = jax.tree.map(
+            lambda m, d, g: g * jnp.sqrt(d + eps) / jnp.sqrt(m + eps), msg, state["msdx"], grads
+        )
+        msdx = jax.tree.map(lambda d, u: rho * d + (1 - rho) * u * u, state["msdx"], updates)
+        return updates, {"msg": msg, "msdx": msdx}
+
+
+@dataclass
+class Adam(IUpdater):
+    """org.nd4j.linalg.learning.AdamUpdater.applyUpdater semantics (bias-
+    corrected first/second moments)."""
+
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": jax.tree.map(jnp.zeros_like, params), "v": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        lr = _lr(self, iteration, epoch)
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        updates = jax.tree.map(lambda m, v: alpha * m / (jnp.sqrt(v) + self.epsilon), m, v)
+        return updates, {"m": m, "v": v}
+
+
+@dataclass
+class AdaMax(IUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": jax.tree.map(jnp.zeros_like, params), "u": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        lr = _lr(self, iteration, epoch)
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        u = jax.tree.map(lambda u, g: jnp.maximum(b2 * u, jnp.abs(g)), state["u"], grads)
+        alpha = lr / (1 - b1 ** t)
+        updates = jax.tree.map(lambda m, u: alpha * m / (u + self.epsilon), m, u)
+        return updates, {"m": m, "u": u}
+
+
+@dataclass
+class Nadam(IUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": jax.tree.map(jnp.zeros_like, params), "v": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        lr = _lr(self, iteration, epoch)
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        mc = 1 - b1 ** t
+        vc = 1 - b2 ** t
+        updates = jax.tree.map(
+            lambda m, v, g: lr * (b1 * m / mc + (1 - b1) * g / mc) / (jnp.sqrt(v / vc) + self.epsilon),
+            m,
+            v,
+            grads,
+        )
+        return updates, {"m": m, "v": v}
+
+
+@dataclass
+class AMSGrad(IUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        z = jax.tree.map(jnp.zeros_like, params)
+        return {"m": z, "v": jax.tree.map(jnp.zeros_like, params), "vhat": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration, epoch=0):
+        lr = _lr(self, iteration, epoch)
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        vhat = jax.tree.map(jnp.maximum, state["vhat"], v)
+        alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        updates = jax.tree.map(lambda m, vh: alpha * m / (jnp.sqrt(vh) + self.epsilon), m, vhat)
+        return updates, {"m": m, "v": v, "vhat": vhat}
+
+
+_UPDATERS = {
+    c.__name__: c
+    for c in (NoOp, Sgd, Nesterovs, AdaGrad, RmsProp, AdaDelta, Adam, AdaMax, Nadam, AMSGrad)
+}
+_SCHEDULES = {
+    c.__name__: c
+    for c in (
+        FixedSchedule,
+        ExponentialSchedule,
+        InverseSchedule,
+        StepSchedule,
+        PolySchedule,
+        SigmoidSchedule,
+        WarmupLinearDecay,
+    )
+}
+
+
+def get(name_or_updater, **kwargs) -> IUpdater:
+    if isinstance(name_or_updater, IUpdater):
+        return name_or_updater
+    cls = _UPDATERS.get(str(name_or_updater).title().replace("_", ""))
+    if cls is None:
+        raise ValueError(f"unknown updater {name_or_updater!r}; known: {sorted(_UPDATERS)}")
+    return cls(**kwargs)

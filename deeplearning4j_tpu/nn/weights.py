@@ -1,0 +1,61 @@
+"""Weight initialization schemes.
+
+Reference: deeplearning4j-nn ``org.deeplearning4j.nn.weights.WeightInit`` enum
++ ``WeightInitUtil`` (XAVIER, XAVIER_UNIFORM, RELU (He), LECUN_NORMAL,
+UNIFORM, NORMAL, ZERO, ONES, IDENTITY, VAR_SCALING_*, DISTRIBUTION).
+fan_in/fan_out conventions follow WeightInitUtil.initWeights.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_weights(key, shape: Tuple[int, ...], fan_in: float, fan_out: float, scheme: str, dtype=jnp.float32):
+    s = scheme.lower()
+    if s == "zero":
+        return jnp.zeros(shape, dtype)
+    if s == "ones":
+        return jnp.ones(shape, dtype)
+    if s == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init needs a square 2-d shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if s == "xavier":
+        # WeightInitUtil: gaussian, var = 2/(fanIn+fanOut)
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / (fan_in + fan_out)).astype(dtype)
+    if s in ("xavier_uniform", "xavieruniform"):
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s in ("xavier_fan_in", "xavierfanin"):
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in).astype(dtype)
+    if s == "relu":
+        # He init: gaussian var=2/fanIn
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in).astype(dtype)
+    if s in ("relu_uniform", "reluuniform"):
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s in ("lecun_normal", "lecunnormal"):
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in).astype(dtype)
+    if s in ("lecun_uniform", "lecununiform"):
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "uniform":
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "normal":
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in).astype(dtype)
+    if s in ("sigmoid_uniform", "sigmoiduniform"):
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s in ("var_scaling_normal_fan_in", "varscalingnormalfanin"):
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in).astype(dtype)
+    if s in ("var_scaling_normal_fan_out", "varscalingnormalfanout"):
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_out).astype(dtype)
+    if s in ("var_scaling_normal_fan_avg", "varscalingnormalfanavg"):
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt((fan_in + fan_out) / 2.0).astype(dtype)
+    raise ValueError(f"unknown weight init scheme {scheme!r}")

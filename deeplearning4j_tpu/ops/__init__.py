@@ -1,0 +1,4 @@
+from .executioner import OpExecutioner, get_executioner, record_op
+from .profiler import OpProfiler, ProfilerConfig
+
+__all__ = ["OpExecutioner", "get_executioner", "record_op", "OpProfiler", "ProfilerConfig"]

@@ -1,0 +1,58 @@
+"""Op executioner: profiling taps + NaN/Inf panic around eager ops.
+
+Reference: nd4j-api ``org.nd4j.linalg.api.ops.executioner.OpExecutioner`` /
+``DefaultOpExecutioner`` (profilingConfigurableHookIn/Out around every exec,
+NaN/Inf panic checks). On TPU the eager "execution" is a traced jnp call that
+XLA compiles + caches, so the executioner is a thin host-side instrumentation
+layer rather than a dispatcher — the dispatch itself is jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..common.environment import env
+
+
+class OpExecutioner:
+    def __init__(self):
+        self._profiler = None
+        self._lock = threading.Lock()
+
+    @property
+    def profiler(self):
+        if self._profiler is None:
+            with self._lock:
+                if self._profiler is None:
+                    from .profiler import OpProfiler
+
+                    self._profiler = OpProfiler()
+        return self._profiler
+
+    def record(self, op_name: str, duration_ns: int = 0) -> None:
+        if env().profiling:
+            self.profiler.record(op_name, duration_ns)
+
+    def check_numerics(self, name: str, arr) -> None:
+        """NaN/Inf panic (DefaultOpExecutioner checkForAny/checkForInf)."""
+        import jax.numpy as jnp
+
+        e = env()
+        if e.check_nan and bool(jnp.any(jnp.isnan(arr))):
+            raise FloatingPointError(f"NaN detected in output of op {name}")
+        if e.check_inf and bool(jnp.any(jnp.isinf(arr))):
+            raise FloatingPointError(f"Inf detected in output of op {name}")
+
+
+_EXECUTIONER = OpExecutioner()
+
+
+def get_executioner() -> OpExecutioner:
+    return _EXECUTIONER
+
+
+def record_op(name: str) -> None:
+    """Cheap hook called from NDArray ops; no-op unless profiling is on."""
+    if env().profiling:
+        _EXECUTIONER.profiler.record(name, 0)

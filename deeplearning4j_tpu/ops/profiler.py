@@ -1,0 +1,132 @@
+"""Op profiler + Chrome-trace emission.
+
+Reference: nd4j ``org.nd4j.linalg.profiler.OpProfiler`` (+``ProfilerConfig``)
+and SameDiff ``org.nd4j.autodiff.listeners.profiler.ProfilingListener`` which
+emits chrome://tracing JSON (SURVEY.md §5.1). The device-side complement on
+TPU is the jax profiler (XPlane); this module covers the host-side per-op
+stats + trace-event file for A/B diffing (ProfileAnalyzer pattern).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class ProfilerConfig:
+    check_for_nan: bool = False
+    check_for_inf: bool = False
+    native_statistics: bool = False
+    trace_events: bool = False  # collect chrome trace events
+
+
+@dataclass
+class _OpStat:
+    count: int = 0
+    total_ns: int = 0
+
+
+class OpProfiler:
+    """Per-op-class counters/timings with reset/print, chrome-trace export."""
+
+    def __init__(self, config: Optional[ProfilerConfig] = None):
+        self.config = config or ProfilerConfig()
+        self._stats: Dict[str, _OpStat] = defaultdict(_OpStat)
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter_ns()
+
+    def record(self, op_name: str, duration_ns: int = 0) -> None:
+        with self._lock:
+            st = self._stats[op_name]
+            st.count += 1
+            st.total_ns += duration_ns
+            if self.config.trace_events:
+                now = time.perf_counter_ns()
+                self._events.append(
+                    {
+                        "name": op_name,
+                        "ph": "X",
+                        "ts": (now - self._t0 - duration_ns) / 1e3,
+                        "dur": max(duration_ns, 1) / 1e3,
+                        "pid": 0,
+                        "tid": threading.get_ident() % 100000,
+                    }
+                )
+
+    def timed(self, op_name: str):
+        """Context manager recording wall duration of a block."""
+        profiler = self
+
+        class _Timer:
+            def __enter__(self):
+                self.start = time.perf_counter_ns()
+                return self
+
+            def __exit__(self, *exc):
+                profiler.record(op_name, time.perf_counter_ns() - self.start)
+
+        return _Timer()
+
+    def stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: {"count": v.count, "total_ns": v.total_ns} for k, v in self._stats.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+            self._events.clear()
+            self._t0 = time.perf_counter_ns()
+
+    def print_stats(self) -> str:
+        lines = ["Op profile:"]
+        for name, st in sorted(self.stats().items(), key=lambda kv: -kv[1]["total_ns"]):
+            lines.append(f"  {name:<30} count={st['count']:<8} total={st['total_ns'] / 1e6:.3f}ms")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def to_chrome_trace(self, path: str) -> None:
+        """Write chrome://tracing-compatible JSON (ProfilingListener parity)."""
+        with self._lock:
+            events = list(self._events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+
+class ProfileAnalyzer:
+    """Diff two chrome traces (org.nd4j...comparison.ProfileAnalyzer parity)."""
+
+    @staticmethod
+    def load(path: str) -> Dict[str, _OpStat]:
+        with open(path) as f:
+            trace = json.load(f)
+        stats: Dict[str, _OpStat] = defaultdict(_OpStat)
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") == "X":
+                st = stats[ev["name"]]
+                st.count += 1
+                st.total_ns += int(ev.get("dur", 0) * 1e3)
+        return stats
+
+    @staticmethod
+    def compare(path_a: str, path_b: str) -> List[dict]:
+        a, b = ProfileAnalyzer.load(path_a), ProfileAnalyzer.load(path_b)
+        rows = []
+        for name in sorted(set(a) | set(b)):
+            rows.append(
+                {
+                    "op": name,
+                    "a_count": a[name].count,
+                    "b_count": b[name].count,
+                    "a_total_ns": a[name].total_ns,
+                    "b_total_ns": b[name].total_ns,
+                    "delta_ns": b[name].total_ns - a[name].total_ns,
+                }
+            )
+        return rows

@@ -1,0 +1,3 @@
+from .random import Random, get_random, set_seed
+
+__all__ = ["Random", "get_random", "set_seed"]

@@ -1,0 +1,140 @@
+"""Stateful RNG facade over JAX's counter-based PRNG.
+
+Reference: libnd4j ``graph/RandomGenerator.h`` (Philox-family counter-based
+generator) + the stateful ``Nd4j.getRandom().setSeed(...)`` JVM facade.
+SURVEY.md §7.2 hard part #5: DL4J tests assume seeded reproducibility of op
+*sequences*; we wrap JAX's threefry key in a stateful object that bumps a
+counter per draw (set_seed(s) → identical subsequent stream). Distributional
+parity, not bitwise parity with nd4j streams (documented divergence).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..common.dtypes import to_jax
+from ..common.environment import env
+
+
+class Random:
+    """Stateful wrapper: every draw folds an incrementing counter into the
+    root key, so the stream is reproducible from (seed) and thread-safe."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.set_seed(seed)
+
+    def set_seed(self, seed: int) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._root = jax.random.key(int(seed))
+            self._counter = 0
+
+    setSeed = set_seed
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """One fresh subkey; the core primitive every draw goes through."""
+        with self._lock:
+            c = self._counter
+            self._counter += 1
+        return jax.random.fold_in(self._root, c)
+
+    def split(self, n: int):
+        return jax.random.split(self.next_key(), n)
+
+    # ---------------------------------------------------------- distributions
+    # (libnd4j loops/cpu/random.hpp distribution kernels parity)
+
+    def uniform(self, shape, minval=0.0, maxval=1.0, dtype=None):
+        from ..ndarray.ndarray import NDArray
+
+        dtype = dtype or to_jax(env().default_float)
+        return NDArray(jax.random.uniform(self.next_key(), shape, dtype=to_jax(dtype), minval=minval, maxval=maxval))
+
+    def normal(self, shape, mean=0.0, std=1.0, dtype=None):
+        from ..ndarray.ndarray import NDArray
+
+        dtype = dtype or to_jax(env().default_float)
+        return NDArray(jax.random.normal(self.next_key(), shape, dtype=to_jax(dtype)) * std + mean)
+
+    gaussian = normal
+
+    def truncated_normal(self, shape, mean=0.0, std=1.0, dtype=None):
+        from ..ndarray.ndarray import NDArray
+
+        dtype = dtype or to_jax(env().default_float)
+        out = jax.random.truncated_normal(self.next_key(), -2.0, 2.0, shape, dtype=to_jax(dtype))
+        return NDArray(out * std + mean)
+
+    def log_normal(self, shape, mean=0.0, std=1.0, dtype=None):
+        from ..ndarray.ndarray import NDArray
+
+        dtype = dtype or to_jax(env().default_float)
+        return NDArray(jnp.exp(jax.random.normal(self.next_key(), shape, dtype=to_jax(dtype)) * std + mean))
+
+    def bernoulli(self, shape, p=0.5, dtype=None):
+        from ..ndarray.ndarray import NDArray
+
+        out = jax.random.bernoulli(self.next_key(), p, shape)
+        return NDArray(out.astype(to_jax(dtype)) if dtype else out)
+
+    def binomial(self, shape, n, p, dtype=None):
+        from ..ndarray.ndarray import NDArray
+
+        draws = jax.random.bernoulli(self.next_key(), p, (n,) + tuple(shape))
+        out = jnp.sum(draws, axis=0)
+        return NDArray(out.astype(to_jax(dtype)) if dtype else out.astype(jnp.int32))
+
+    def exponential(self, shape, lam=1.0, dtype=None):
+        from ..ndarray.ndarray import NDArray
+
+        dtype = dtype or to_jax(env().default_float)
+        return NDArray(jax.random.exponential(self.next_key(), shape, dtype=to_jax(dtype)) / lam)
+
+    def randint(self, shape, minval, maxval, dtype=None):
+        from ..ndarray.ndarray import NDArray
+
+        return NDArray(jax.random.randint(self.next_key(), shape, minval, maxval, dtype=to_jax(dtype or "int32")))
+
+    def permutation(self, n: int):
+        from ..ndarray.ndarray import NDArray
+
+        return NDArray(jax.random.permutation(self.next_key(), n))
+
+    def shuffle(self, arr, axis: int = 0):
+        from ..ndarray.ndarray import NDArray, _unwrap
+
+        return NDArray(jax.random.permutation(self.next_key(), jnp.asarray(_unwrap(arr)), axis=axis))
+
+    def dropout_mask(self, shape, keep_prob: float, dtype=None):
+        """Inverted-dropout mask (libnd4j helpers dropout parity)."""
+        from ..ndarray.ndarray import NDArray
+
+        dtype = dtype or to_jax(env().default_float)
+        keep = jax.random.bernoulli(self.next_key(), keep_prob, shape)
+        return NDArray(keep.astype(to_jax(dtype)) / keep_prob)
+
+
+_GLOBAL = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_random() -> Random:
+    """Process-global stateful RNG (Nd4j.getRandom())."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = Random(env().seed)
+    return _GLOBAL
+
+
+def set_seed(seed: int) -> None:
+    get_random().set_seed(seed)

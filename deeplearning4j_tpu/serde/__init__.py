@@ -1,0 +1,3 @@
+from .model_serializer import ModelSerializer
+
+__all__ = ["ModelSerializer"]

@@ -1,0 +1,36 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax import.
+
+SURVEY.md §4.6 #5: `XLA_FLAGS=--xla_force_host_platform_device_count=8` +
+`JAX_PLATFORMS=cpu` is the TPU-world analog of DL4J's `local[N]` Spark tests —
+multi-device semantics with zero real chips. Must run before anything imports
+jax, which pytest guarantees for conftest at collection start.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("TDL_DEFAULT_FLOAT", "float32")
+
+# The axon sitecustomize has ALREADY imported jax and registered the real-TPU
+# tunnel plugin at interpreter startup, with JAX_PLATFORMS=axon captured into
+# jax.config. Env mutation alone is too late — force the config post-import so
+# backends() never initializes the tunnel client during tests.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seeded_rng():
+    """Deterministic global RNG per test (BaseNd4jTest seeds Nd4j RNG)."""
+    from deeplearning4j_tpu.rng import set_seed
+
+    set_seed(12345)
+    np.random.seed(12345)
+    yield

@@ -1,0 +1,326 @@
+"""INDArray-semantics tests, modeled on nd4j's Nd4jTestsC corpus
+(SURVEY.md §4.2): views aliasing storage, in-place ops, 'c'/'f' order,
+broadcasting, reductions — golden-checked against numpy."""
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.ndarray as nd
+from deeplearning4j_tpu.common.dtypes import DataType, promote_types
+
+
+class TestCreation:
+    def test_zeros_ones(self):
+        z = nd.zeros(2, 3)
+        assert z.shape == (2, 3)
+        assert np.allclose(z.numpy(), 0)
+        o = nd.ones((4,))
+        assert np.allclose(o.numpy(), 1)
+
+    def test_default_float_is_f32(self):
+        a = nd.array([[1.0, 2.0]])
+        assert a.data_type == DataType.FLOAT
+
+    def test_arange_linspace_eye(self):
+        assert np.array_equal(nd.arange(5).numpy(), np.arange(5))
+        assert np.allclose(nd.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+        assert np.allclose(nd.eye(3).numpy(), np.eye(3))
+
+    def test_value_array_of(self):
+        v = nd.value_array_of((2, 2), 3.5)
+        assert np.allclose(v.numpy(), 3.5)
+
+    def test_empty(self):
+        e = nd.empty()
+        assert e.is_empty()
+
+    def test_one_hot(self):
+        oh = nd.factory.one_hot(nd.array([0, 2]), 3)
+        assert np.allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+class TestDtypes:
+    def test_promotion_float_beats_int(self):
+        assert promote_types(DataType.FLOAT, DataType.LONG) == DataType.FLOAT
+
+    def test_promotion_half_bf16(self):
+        assert promote_types(DataType.HALF, DataType.BFLOAT16) == DataType.FLOAT
+
+    def test_promotion_wider_wins(self):
+        assert promote_types(DataType.INT, DataType.LONG) == DataType.LONG
+        assert promote_types(DataType.FLOAT, DataType.DOUBLE) == DataType.DOUBLE
+
+    def test_cast(self):
+        a = nd.array([1.7, 2.3])
+        b = a.cast_to(DataType.INT)
+        assert b.data_type == DataType.INT
+        assert np.array_equal(b.numpy(), [1, 2])
+
+
+class TestArithmetic:
+    def test_add_sub_mul_div(self):
+        a, b = nd.array([1.0, 2.0, 3.0]), nd.array([4.0, 5.0, 6.0])
+        assert np.allclose((a + b).numpy(), [5, 7, 9])
+        assert np.allclose((a - b).numpy(), [-3, -3, -3])
+        assert np.allclose((a * b).numpy(), [4, 10, 18])
+        assert np.allclose((b / a).numpy(), [4, 2.5, 2])
+
+    def test_rsub_rdiv(self):
+        a = nd.array([1.0, 2.0, 4.0])
+        assert np.allclose(a.rsub(10).numpy(), [9, 8, 6])
+        assert np.allclose(a.rdiv(8).numpy(), [8, 4, 2])
+
+    def test_inplace_returns_self_and_mutates(self):
+        a = nd.array([1.0, 2.0])
+        r = a.addi(1)
+        assert r is a
+        assert np.allclose(a.numpy(), [2, 3])
+
+    def test_scalar_broadcast(self):
+        a = nd.ones(2, 2)
+        assert np.allclose((a + 5).numpy(), 6)
+        assert np.allclose((2 * a).numpy(), 2)
+
+    def test_row_column_vector_ops(self):
+        m = nd.zeros(2, 3)
+        m.addi_row_vector(nd.array([1.0, 2.0, 3.0]))
+        assert np.allclose(m.numpy(), [[1, 2, 3], [1, 2, 3]])
+        m.addi_column_vector(nd.array([[10.0], [20.0]]))
+        assert np.allclose(m.numpy(), [[11, 12, 13], [21, 22, 23]])
+
+    def test_comparison_ops_bool(self):
+        a = nd.array([1.0, 5.0])
+        g = a.gt(2)
+        assert g.data_type == DataType.BOOL
+        assert np.array_equal(g.numpy(), [False, True])
+
+    def test_neg(self):
+        a = nd.array([1.0, -2.0])
+        assert np.allclose((-a).numpy(), [-1, 2])
+
+
+class TestViewsAliasing:
+    """The DL4J contract: views alias storage, writes via views visible in base
+    (BaseNDArray.get(NDArrayIndex...) semantics)."""
+
+    def test_row_view_write_visible_in_base(self):
+        m = nd.zeros(3, 3)
+        row = m.get_row(1)
+        row.assign(nd.array([1.0, 2.0, 3.0]))
+        assert np.allclose(m.numpy(), [[0, 0, 0], [1, 2, 3], [0, 0, 0]])
+
+    def test_view_addi_mutates_base(self):
+        m = nd.ones(2, 4)
+        col = m.get_column(2)
+        col.addi(10)
+        expected = np.ones((2, 4))
+        expected[:, 2] += 10
+        assert np.allclose(m.numpy(), expected)
+
+    def test_base_write_visible_in_view(self):
+        m = nd.zeros(2, 2)
+        v = m[0]
+        m.assign(7)
+        assert np.allclose(v.numpy(), [7, 7])
+
+    def test_interval_view(self):
+        a = nd.arange(10, dtype="float32")
+        v = a[2:7]
+        v.muli(0)
+        out = a.numpy()
+        assert np.allclose(out[2:7], 0)
+        assert np.allclose(out[:2], [0, 1])
+        assert np.allclose(out[7:], [7, 8, 9])
+
+    def test_view_of_view_composition(self):
+        a = nd.arange(20, dtype="float32").reshape(4, 5)
+        v1 = a[1:3]         # rows 1..2
+        v2 = v1[1]          # row 2 of a
+        v2.addi(100)
+        out = a.numpy()
+        assert np.allclose(out[2], np.arange(10, 15) + 100)
+        assert np.allclose(out[1], np.arange(5, 10))
+
+    def test_strided_view(self):
+        a = nd.arange(10, dtype="float32")
+        v = a[::2]
+        v.addi(1)
+        assert np.allclose(a.numpy(), [1, 1, 3, 3, 5, 5, 7, 7, 9, 9])
+
+    def test_negative_step_view(self):
+        """Regression: reversed-slice views composed to length 0."""
+        a = nd.arange(6, dtype="float32")
+        v = a[::-1]
+        assert np.allclose(v.numpy(), [5, 4, 3, 2, 1, 0])
+        assert v.get_double(0) == 5.0
+        v2 = a[0:6][::-1]
+        assert np.allclose(v2.numpy(), [5, 4, 3, 2, 1, 0])
+        v2.put_scalar(0, 99.0)
+        assert a.get_double(5) == 99.0
+
+    def test_newaxis_copies(self):
+        a = nd.arange(6, dtype="float32").reshape(2, 3)
+        w = a[None]
+        assert w.shape == (1, 2, 3)
+        w.addi(1)  # copy — must NOT mutate a
+        assert np.allclose(a.numpy(), np.arange(6).reshape(2, 3))
+
+    def test_dup_detaches(self):
+        m = nd.zeros(2, 2)
+        d = m.get_row(0).dup()
+        d.addi(5)
+        assert np.allclose(m.numpy(), 0)
+
+    def test_put_scalar_and_get(self):
+        m = nd.zeros(2, 2)
+        m.put_scalar((0, 1), 42.0)
+        assert m.get_double(0, 1) == 42.0
+
+    def test_setitem(self):
+        m = nd.zeros(3, 3)
+        m[1, :] = nd.array([1.0, 2.0, 3.0])
+        assert np.allclose(m.numpy()[1], [1, 2, 3])
+
+
+class TestShapeOps:
+    def test_reshape_c(self):
+        a = nd.arange(6).reshape(2, 3)
+        assert np.array_equal(a.numpy(), np.arange(6).reshape(2, 3))
+
+    def test_reshape_f(self):
+        a = nd.arange(6, dtype="float32")
+        f = a.reshape(2, 3, order="f")
+        assert np.array_equal(f.numpy(), np.arange(6).reshape(2, 3, order="F"))
+
+    def test_ravel_f(self):
+        a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+        assert np.allclose(a.ravel("f").numpy(), [1, 3, 2, 4])
+        assert np.allclose(a.ravel("c").numpy(), [1, 2, 3, 4])
+
+    def test_transpose_permute(self):
+        a = nd.arange(24).reshape(2, 3, 4)
+        assert a.T.shape == (4, 3, 2)
+        assert a.permute(2, 0, 1).shape == (4, 2, 3)
+
+    def test_tad(self):
+        a = nd.arange(24, dtype="float32").reshape(2, 3, 4)
+        assert a.tensors_along_dimension(2) == 6
+        t = a.tensor_along_dimension(1, 2)  # second row-of-4: [0,1,:]
+        assert np.allclose(t.numpy(), [4, 5, 6, 7])
+        t.addi(1000)
+        assert np.allclose(a.numpy()[0, 1], [1004, 1005, 1006, 1007])
+
+    def test_squeeze_expand(self):
+        a = nd.zeros(1, 3, 1)
+        assert a.squeeze().shape == (3,)
+        assert a.expand_dims(0).shape == (1, 1, 3, 1)
+
+
+class TestReductions:
+    def test_sum_mean_dims(self):
+        a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+        assert np.allclose(a.sum(0).numpy(), [4, 6])
+        assert np.allclose(a.sum(1).numpy(), [3, 7])
+        assert a.sum_number() == 10.0
+        assert a.mean_number() == 2.5
+
+    def test_std_var_bias_corrected(self):
+        a = nd.array([1.0, 2.0, 3.0, 4.0])
+        assert abs(a.std_number() - np.std([1, 2, 3, 4], ddof=1)) < 1e-6
+        assert abs(a.var_number(False) - np.var([1, 2, 3, 4])) < 1e-6
+
+    def test_norms(self):
+        a = nd.array([3.0, -4.0])
+        assert a.norm1_number() == 7.0
+        assert a.norm2_number() == 5.0
+
+    def test_argmax_argmin(self):
+        a = nd.array([[1.0, 9.0, 2.0], [8.0, 0.0, 3.0]])
+        assert np.array_equal(a.argmax(1).numpy(), [1, 0])
+        assert np.array_equal(a.argmin(0).numpy(), [0, 1, 0])
+
+    def test_cumsum(self):
+        a = nd.array([1.0, 2.0, 3.0])
+        assert np.allclose(a.cumsum(0).numpy(), [1, 3, 6])
+
+
+class TestLinalg:
+    def test_mmul(self):
+        a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+        b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+        assert np.allclose(a.mmul(b).numpy(), np.array([[19, 22], [43, 50]]))
+
+    def test_mmul_transpose_flags(self):
+        a = nd.rand(3, 2)
+        b = nd.rand(3, 4)
+        out = a.mmul(b, transpose_a=True)
+        assert np.allclose(out.numpy(), a.numpy().T @ b.numpy(), atol=1e-5)
+
+    def test_batched_mmul(self):
+        a, b = nd.rand(5, 2, 3), nd.rand(5, 3, 4)
+        out = a.mmul(b)
+        assert out.shape == (5, 2, 4)
+        assert np.allclose(out.numpy(), a.numpy() @ b.numpy(), atol=1e-5)
+
+    def test_dot(self):
+        assert nd.array([1.0, 2.0]).dot(nd.array([3.0, 4.0])) == 11.0
+
+
+class TestFactoryOps:
+    def test_concat_stack(self):
+        a, b = nd.ones(2, 2), nd.zeros(2, 2)
+        assert nd.concat(0, a, b).shape == (4, 2)
+        assert nd.concat(1, a, b).shape == (2, 4)
+        assert nd.stack(0, a, b).shape == (2, 2, 2)
+
+    def test_where(self):
+        out = nd.where(nd.array([True, False]), nd.array([1.0, 1.0]), nd.array([2.0, 2.0]))
+        assert np.allclose(out.numpy(), [1, 2])
+
+    def test_sort(self):
+        assert np.allclose(nd.factory.sort(nd.array([3.0, 1.0, 2.0])).numpy(), [1, 2, 3])
+        assert np.allclose(nd.factory.sort(nd.array([3.0, 1.0, 2.0]), descending=True).numpy(), [3, 2, 1])
+
+
+class TestEquality:
+    def test_equals_to(self):
+        a = nd.array([1.0, 2.0])
+        assert a.equals_to(nd.array([1.0, 2.0]))
+        assert a.equals_to(nd.array([1.0, 2.0 + 1e-7]))
+        assert not a.equals_to(nd.array([1.0, 2.1]))
+        assert not a.equals_to(nd.array([1.0, 2.0, 3.0]))
+
+
+class TestRng:
+    def test_seeded_reproducibility(self):
+        from deeplearning4j_tpu.rng import get_random, set_seed
+
+        set_seed(42)
+        a = nd.rand(3, 3).numpy()
+        set_seed(42)
+        b = nd.rand(3, 3).numpy()
+        assert np.array_equal(a, b)
+
+    def test_stream_advances(self):
+        a = nd.rand(3).numpy()
+        b = nd.rand(3).numpy()
+        assert not np.array_equal(a, b)
+
+    def test_distributions_moments(self):
+        from deeplearning4j_tpu.rng import get_random
+
+        r = get_random()
+        n = r.normal((20000,), mean=2.0, std=3.0).numpy()
+        assert abs(n.mean() - 2.0) < 0.1
+        assert abs(n.std() - 3.0) < 0.1
+        u = r.uniform((20000,), minval=-1, maxval=1).numpy()
+        assert abs(u.mean()) < 0.05
+        bern = r.bernoulli((20000,), p=0.3).numpy()
+        assert abs(bern.mean() - 0.3) < 0.02
+
+    def test_dropout_mask_inverted(self):
+        from deeplearning4j_tpu.rng import get_random
+
+        m = get_random().dropout_mask((10000,), keep_prob=0.5).numpy()
+        assert set(np.unique(m)).issubset({0.0, 2.0})
+        assert abs(m.mean() - 1.0) < 0.1
