@@ -262,6 +262,7 @@ class MultiLayerNetwork:
         rnn_states = self._zero_rnn_states(B)
         fmask_all = None if ds.features_mask is None else np.asarray(ds.features_mask)
         lmask_all = None if ds.labels_mask is None else np.asarray(ds.labels_mask)
+        loss_weighted, weight_total = 0.0, 0.0
         for seg_start in range(0, T, fwd):
             seg = slice(seg_start, min(seg_start + fwd, T))
             seg_len = seg.stop - seg.start
@@ -286,7 +287,12 @@ class MultiLayerNetwork:
                 jnp.asarray(x_seg, self._dtype), jnp.asarray(y_seg),
                 None if fm is None else jnp.asarray(fm), jnp.asarray(lm), rng,
             )
-        self.score_ = float(loss)
+            w = float(np.sum(lm))
+            loss_weighted += float(loss) * w
+            weight_total += w
+        # fit-wide score = unmasked-timestep-weighted mean over segments (the
+        # reference reports one score per fit call, not per tbptt segment)
+        self.score_ = loss_weighted / weight_total if weight_total > 0 else float(loss)
         self.iteration += 1
         for lst in self.listeners:
             if hasattr(lst, "iteration_done"):

@@ -1,0 +1,25 @@
+from .mesh import MeshSpec, build_mesh, device_count
+from .sharding import ShardingRules, DP, TP_COLUMN, TP_ROW, replicated, shard_batch, shard_params
+from .trainer import ParallelTrainer, ParameterAveragingTrainingMaster, SharedTrainingMaster
+from .wrapper import ParallelWrapper
+from .inference import ParallelInference
+from . import collectives
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "device_count",
+    "ShardingRules",
+    "DP",
+    "TP_COLUMN",
+    "TP_ROW",
+    "replicated",
+    "shard_batch",
+    "shard_params",
+    "ParallelTrainer",
+    "ParameterAveragingTrainingMaster",
+    "SharedTrainingMaster",
+    "ParallelWrapper",
+    "ParallelInference",
+    "collectives",
+]

@@ -1,0 +1,83 @@
+"""Device mesh construction.
+
+Reference inversion (SURVEY §2.10): the reference's distribution topology is
+an Aeron UDP unicast tree built by ``MeshOrganizer`` (nd4j
+``org.nd4j.parameterserver.distributed.v2.util.MeshOrganizer``) carrying
+threshold-encoded gradients; on TPU the topology is a ``jax.sharding.Mesh``
+over ICI and the "transport" is XLA collectives compiled into the step.
+Axis vocabulary (data/model/pipe/seq/expert) covers DP/TP/PP/SP-CP/EP — the
+modern modes the reference lacks (§2.10 table).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_PIPE = "pipe"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+@dataclass
+class MeshSpec:
+    """Named axis sizes; -1 on one axis = absorb remaining devices."""
+
+    axes: Dict[str, int] = field(default_factory=lambda: {AXIS_DATA: -1})
+
+    def resolve(self, n_devices: Optional[int] = None) -> Dict[str, int]:
+        n = n_devices or device_count()
+        sizes = dict(self.axes)
+        fixed = 1
+        wild = None
+        for k, v in sizes.items():
+            if v == -1:
+                if wild is not None:
+                    raise ValueError("only one axis may be -1")
+                wild = k
+            else:
+                fixed *= v
+        if wild is not None:
+            if n % fixed != 0:
+                raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+            sizes[wild] = n // fixed
+        total = math.prod(sizes.values())
+        if total != n:
+            raise ValueError(f"mesh axes {sizes} product {total} != device count {n}")
+        return sizes
+
+
+def build_mesh(spec: Optional[MeshSpec] = None, devices: Optional[Sequence] = None, **axes: int) -> Mesh:
+    """Build a Mesh. ``build_mesh(data=4, model=2)`` or ``build_mesh()`` for
+    pure-DP over all devices. Device order follows jax.devices() — on real
+    hardware that order is ICI-contiguous, so the innermost (last) axis gets
+    nearest neighbors: put the most communication-heavy axis LAST (usually
+    'model' for TP or 'seq' for ring attention)."""
+    if spec is None:
+        spec = MeshSpec(axes=dict(axes) if axes else {AXIS_DATA: -1})
+    devs = list(devices) if devices is not None else jax.devices()
+    sizes = spec.resolve(len(devs))
+    names = tuple(sizes.keys())
+    shape = tuple(sizes.values())
+    dev_array = np.asarray(devs).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    return build_mesh(MeshSpec({AXIS_DATA: -1}), devices=jax.devices()[: n or device_count()])
+
+
+def tp_dp_mesh(model: int, n: Optional[int] = None) -> Mesh:
+    """2-D mesh: data outer (DCN-friendly), model inner (ICI-neighbors)."""
+    return build_mesh(MeshSpec({AXIS_DATA: -1, AXIS_MODEL: model}), devices=jax.devices()[: n or device_count()])
