@@ -1,0 +1,92 @@
+"""Benchmark runner — prints ONE JSON line.
+
+Headline metric (BASELINE.json): ResNet-50 images/sec/chip. The whole
+train step (forward+backward+updater) is one compiled XLA executable; the
+loop below keeps dispatch async and only syncs at the end.
+
+No reference numbers exist to compare against (BASELINE.json "published" is
+empty; see BASELINE.md provenance note), so vs_baseline is reported as the
+ratio against the value recorded in BENCH_BASELINE.json once a previous
+round has produced one (self-relative trend), else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models import ResNet50
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    # full ImageNet-shape config on TPU; reduced config for CPU smoke runs
+    if on_tpu:
+        batch, hw, classes, steps, warmup = 128, 224, 1000, 20, 3
+    else:
+        batch, hw, classes, steps, warmup = 8, 64, 10, 5, 2
+
+    net = ResNet50(num_classes=classes, input_shape=(3, hw, hw)).init()
+    step = net._train_step_fn()
+
+    rs = np.random.RandomState(0)
+    x = {"input": jnp.asarray(rs.rand(batch, 3, hw, hw).astype(np.float32))}
+    y = {"output": jnp.asarray(np.eye(classes, dtype=np.float32)[rs.randint(0, classes, batch)])}
+    rng = jax.random.key(0)
+    it = jnp.asarray(0, jnp.int32)
+    ep = jnp.asarray(0, jnp.int32)
+
+    params, opt, bn = net.params_, net.updater_state, net.bn_state
+    for i in range(warmup):
+        params, opt, bn, loss = step(params, opt, bn, it, ep, x, y, None, rng)
+    float(loss)  # device fetch = true sync (block_until_ready alone does not
+    # drain the axon tunnel's async dispatch queue)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, opt, bn, loss = step(params, opt, bn, it, ep, x, y, None, rng)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / dt
+
+    baseline_file = pathlib.Path(__file__).parent / "BENCH_BASELINE.json"
+    vs = 1.0
+    prev = None
+    if baseline_file.exists():
+        try:
+            d = json.loads(baseline_file.read_text())
+            if d.get("backend") == backend:
+                prev = d.get("value")
+        except Exception:
+            pass
+    if prev:
+        vs = images_per_sec / prev
+    else:
+        baseline_file.write_text(json.dumps(
+            {"metric": "resnet50_train_images_per_sec", "value": images_per_sec,
+             "backend": backend, "batch": batch, "image": hw}))
+
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3),
+        "backend": backend,
+        "batch": batch,
+        "image_size": hw,
+        "num_classes": classes,
+    }))
+
+
+if __name__ == "__main__":
+    main()

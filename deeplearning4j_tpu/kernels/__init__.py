@@ -1,0 +1,27 @@
+"""Pallas TPU kernels + distributed attention.
+
+Reference analog: libnd4j's hand-written CUDA kernels under
+``ops/declarable/helpers/cuda/`` (SURVEY §2.1 N7) and the cuDNN platform
+helpers (N10). On TPU the XLA compiler covers most of that ground; Pallas
+kernels are reserved for the ops where hand-tiling beats XLA — attention
+first (the reference's ``multi_head_dot_product_attention`` materializes the
+full [B,H,T,T] score matrix; flash attention is O(T) memory).
+
+The "fast path vs reference path" parity-test pattern (cuDNN helper vs plain
+nd4j ops, SURVEY §4.3) is kept: every kernel here has a plain-XLA reference
+implementation and a parity test.
+"""
+
+from .attention import (
+    dot_product_attention,
+    flash_attention,
+    mha_reference,
+    ring_attention,
+)
+
+__all__ = [
+    "dot_product_attention",
+    "flash_attention",
+    "mha_reference",
+    "ring_attention",
+]

@@ -1,0 +1,212 @@
+"""Attention kernels: plain-XLA reference, Pallas flash attention, ring
+attention for sequence/context parallelism.
+
+Reference parity: libnd4j ``ops/declarable/generic/nn/dot_product_attention.cpp``
+and ``multi_head_dot_product_attention.cpp`` (SURVEY §2.1 N6) implement
+attention by materializing the [B,H,Tq,Tk] score matrix. The reference has
+NO flash/blockwise/distributed attention anywhere (SURVEY §5.7) — these are
+the mandated TPU-native additions.
+
+Layout convention: q/k/v are [B, H, T, D] (batch, heads, time, head_dim).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def mha_reference(q, k, v, mask=None, *, causal: bool = False, scale: Optional[float] = None):
+    """Plain-XLA multi-head attention (the 'reference path' for parity tests;
+    equivalent math to libnd4j multi_head_dot_product_attention: softmax(QK^T
+    / sqrt(d)) V with full score materialization, O(T^2) memory)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        Tq, Tk = scores.shape[-2], scores.shape[-1]
+        qpos = jnp.arange(Tq)[:, None] + (Tk - Tq)
+        cmask = qpos >= jnp.arange(Tk)[None, :]
+        scores = jnp.where(cmask, scores, _NEG_INF)
+    if mask is not None:
+        # mask: [B, Tk] or [B, 1, Tq, Tk]; 1 = attend, 0 = ignore
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        scores = jnp.where(mask.astype(bool), scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+# --------------------------------------------------------------------- flash
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, causal, block_q, block_k, num_k, q_offset):
+    """One (q-block, k-block) grid step of online-softmax flash attention.
+
+    TPU grid iterates the LAST axis sequentially, so scratch (m/l/acc)
+    persists across the k-block sweep for a fixed q-block.
+    """
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    if causal:
+        qb = pl.program_id(1)
+        # q_offset aligns query positions to the END of the key axis when
+        # Tq != Tk (decode-with-prefix), matching mha_reference
+        qpos = q_offset + qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+
+    m_prev = m_ref[:]          # [bq, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)     # [bq, bk]
+    l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = m_new
+
+    @pl.when(kb == num_k - 1)
+    def _fin():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128, interpret: Optional[bool] = None):
+    """Pallas flash attention, O(T) memory (blockwise online softmax).
+
+    Falls back to interpret mode off-TPU so the same code path is testable on
+    the CPU mesh (SURVEY §4.6 #4: fast-path vs reference-path parity harness).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k:
+        raise ValueError(f"sequence lengths ({Tq},{Tk}) must divide blocks ({block_q},{block_k})")
+    num_k = Tk // block_k
+
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=num_k, q_offset=Tk - Tq)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D)
+
+
+# ---------------------------------------------------------------------- ring
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False, scale: Optional[float] = None,
+                   key_mask=None):
+    """Ring attention for context parallelism (SURVEY §5.7 TPU-native plan).
+
+    Call INSIDE shard_map with the sequence axis sharded over ``axis_name``:
+    each device holds local shards [B, H, T_local, D]; K/V blocks rotate
+    around the ICI ring via ppermute while a running online-softmax
+    accumulator merges per-block partial attention — O(T_local) memory per
+    device, near-linear sequence scaling.
+
+    ``key_mask``: optional [B, T_local] (1 = attend), the local shard of a
+    padding mask; it rotates around the ring together with its K/V block.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+
+    qpos = me * Tl + jnp.arange(Tl)  # global query positions
+
+    def block(carry, kv_and_idx):
+        m, l, acc, kb, vb, mb, src = carry
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kb.astype(jnp.float32)) * scale
+        if causal:
+            kpos = src * Tl + jnp.arange(Tl)
+            cmask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(cmask[None, None], s, _NEG_INF)
+        if mb is not None:
+            s = jnp.where(mb[:, None, None, :].astype(bool), s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+        # rotate K/V (+mask) to the next device on the ring (ICI ppermute)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        if mb is not None:
+            mb = jax.lax.ppermute(mb, axis_name, perm)
+        src = (src - 1) % n  # after rotation we hold the previous device's shard
+        return (m_new, l, acc, kb, vb, mb, src), None
+
+    m0 = jnp.full((B, H, Tl, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
+    a0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+    carry = (m0, l0, a0, k, v, key_mask, me)
+    # n is static (mesh size) → unrolled python loop keeps ppermute scheduling
+    # visible to XLA for compute/comm overlap
+    for _ in range(n):
+        carry, _ = block(carry, None)
+    m, l, acc, _, _, _, _ = carry
+    return (acc / l).astype(q.dtype)
+
+
+def dot_product_attention(q, k, v, mask=None, *, causal=False, scale=None, impl: str = "auto"):
+    """Front door used by nn layers / the transformer. impl: auto|xla|flash.
+
+    auto = flash on TPU when shapes tile cleanly, else plain XLA.
+    """
+    if impl == "flash" or (
+        impl == "auto"
+        and mask is None
+        and jax.default_backend() == "tpu"
+        and q.shape[-2] % 128 == 0
+        and k.shape[-2] % 128 == 0
+    ):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return mha_reference(q, k, v, mask, causal=causal, scale=scale)

@@ -1,0 +1,37 @@
+"""Model zoo + flagship models.
+
+Reference analog: ``deeplearning4j-zoo`` (SURVEY §2.4 C15: ZooModel SPI with
+LeNet/AlexNet/VGG16/ResNet50/YOLO2/…) plus the BERT workload the reference
+runs through TF-import into SameDiff (SURVEY §3.3).
+
+The zoo models build on the conf/MLN/CG stack for API parity; the flagship
+``transformer`` is a TPU-first functional model (pure init/forward/loss with
+PartitionSpec trees for dp/tp/sp meshes) — the shape a JAX-native user
+expects, and the vehicle for the distributed benchmarks.
+"""
+
+from .transformer import (
+    TransformerConfig,
+    forward as transformer_forward,
+    init_params as transformer_init,
+    loss_fn as transformer_loss,
+    partition_specs as transformer_partition_specs,
+)
+from .zoo import LeNet, SimpleCNN, ZooModel
+from .resnet import ResNet50
+from .vgg import VGG16
+from .text_lstm import TextGenerationLSTM
+
+__all__ = [
+    "TransformerConfig",
+    "transformer_forward",
+    "transformer_init",
+    "transformer_loss",
+    "transformer_partition_specs",
+    "ZooModel",
+    "LeNet",
+    "SimpleCNN",
+    "ResNet50",
+    "VGG16",
+    "TextGenerationLSTM",
+]

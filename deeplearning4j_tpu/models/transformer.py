@@ -1,0 +1,263 @@
+"""Flagship transformer (BERT-base family) — TPU-first functional model.
+
+Reference parity: the reference's BERT story is a TF-imported SameDiff graph
+(SURVEY §3.3: TFGraphMapper → ~1.2k-node graph executed op-by-op, one JNI
+round-trip per node). Here the model is a pure JAX function: the whole
+forward+backward+updater step compiles to ONE XLA executable, and
+parallelism is declared with a PartitionSpec tree over a
+``jax.sharding.Mesh`` instead of the reference's Aeron parameter server
+(SURVEY §2.10).
+
+Mesh axes (any subset may be present):
+- ``dp`` — data parallel (batch sharding; gradient allreduce over ICI)
+- ``tp`` — tensor parallel (Megatron column/row splits on attention + MLP)
+- ``sp`` — sequence/context parallel (ring attention over the ICI ring)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..kernels.attention import dot_product_attention, ring_attention
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 30522          # BERT-base WordPiece vocab
+    max_len: int = 512
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    type_vocab: int = 2              # segment ids (BERT)
+    causal: bool = False             # False = BERT encoder, True = GPT-style LM
+    dropout: float = 0.1
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16  # bf16 matmuls on the MXU, fp32 master params
+    attn_impl: str = "auto"          # auto | xla | flash | ring
+    sequence_axis: Optional[str] = None  # mesh axis for ring attention ("sp")
+    remat: bool = False              # jax.checkpoint each block (HBM for FLOPs)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def bert_base(**kw) -> "TransformerConfig":
+        return TransformerConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "TransformerConfig":
+        kw.setdefault("vocab_size", 1024)
+        kw.setdefault("max_len", 128)
+        kw.setdefault("d_model", 128)
+        kw.setdefault("n_heads", 4)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("d_ff", 512)
+        return TransformerConfig(**kw)
+
+
+# ---------------------------------------------------------------------- init
+
+
+def init_params(key, cfg: TransformerConfig) -> Dict[str, Any]:
+    dt = cfg.param_dtype
+    D, F, V = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    std = 0.02
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape) * std).astype(dt)
+
+    keys = iter(jax.random.split(key, 6 + 8 * cfg.n_layers))
+    params: Dict[str, Any] = {
+        "embed": {
+            "tok": dense(next(keys), (V, D)),
+            "pos": dense(next(keys), (cfg.max_len, D)),
+            "seg": dense(next(keys), (cfg.type_vocab, D)),
+            "ln_scale": jnp.ones((D,), dt),
+            "ln_bias": jnp.zeros((D,), dt),
+        },
+        "blocks": [],
+        "mlm": {
+            "w": dense(next(keys), (D, D)),
+            "b": jnp.zeros((D,), dt),
+            "ln_scale": jnp.ones((D,), dt),
+            "ln_bias": jnp.zeros((D,), dt),
+            "out_bias": jnp.zeros((V,), dt),
+        },
+    }
+    for _ in range(cfg.n_layers):
+        params["blocks"].append({
+            "qkv_w": dense(next(keys), (D, 3 * D)),
+            "qkv_b": jnp.zeros((3 * D,), dt),
+            "out_w": dense(next(keys), (D, D)),
+            "out_b": jnp.zeros((D,), dt),
+            "ln1_scale": jnp.ones((D,), dt), "ln1_bias": jnp.zeros((D,), dt),
+            "ffn_w1": dense(next(keys), (D, F)),
+            "ffn_b1": jnp.zeros((F,), dt),
+            "ffn_w2": dense(next(keys), (F, D)),
+            "ffn_b2": jnp.zeros((D,), dt),
+            "ln2_scale": jnp.ones((D,), dt), "ln2_bias": jnp.zeros((D,), dt),
+        })
+    return params
+
+
+def partition_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpec tree matching init_params: Megatron-style tp splits.
+
+    qkv/ffn_w1 column-split (output dim on tp), out_w/ffn_w2 row-split
+    (input dim on tp) — GSPMD inserts the ICI all-reduces at the row-split
+    outputs, exactly the Megatron comm pattern.
+    """
+    block = {
+        "qkv_w": P(None, "tp"), "qkv_b": P("tp"),
+        "out_w": P("tp", None), "out_b": P(),
+        "ln1_scale": P(), "ln1_bias": P(),
+        "ffn_w1": P(None, "tp"), "ffn_b1": P("tp"),
+        "ffn_w2": P("tp", None), "ffn_b2": P(),
+        "ln2_scale": P(), "ln2_bias": P(),
+    }
+    return {
+        "embed": {
+            "tok": P("tp", None),  # vocab-sharded embedding (SURVEY §2.10 EP row)
+            "pos": P(), "seg": P(),
+            "ln_scale": P(), "ln_bias": P(),
+        },
+        "blocks": [dict(block) for _ in range(cfg.n_layers)],
+        "mlm": {"w": P(), "b": P(), "ln_scale": P(), "ln_bias": P(),
+                "out_bias": P("tp")},
+    }
+
+
+def batch_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Input sharding: batch over dp, sequence over sp (if present)."""
+    sp = cfg.sequence_axis
+    tok = P("dp", sp)
+    return {"tokens": tok, "segments": tok, "labels": tok, "weights": tok}
+
+
+# ------------------------------------------------------------------- forward
+
+
+def _layer_norm(x, scale, bias, eps=1e-12):
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32))
+
+
+def _attention(cfg: TransformerConfig, q, k, v, pad_mask):
+    if cfg.attn_impl == "ring" and cfg.sequence_axis:
+        # sequence-sharded ring attention inside shard_map; head axis may be
+        # tp-sharded at the same time — specs reference only present axes.
+        mesh = jax.sharding.get_abstract_mesh()
+        tp = "tp" if "tp" in mesh.axis_names else None
+        dp = "dp" if "dp" in mesh.axis_names else None
+        spec = P(dp, tp, cfg.sequence_axis, None)
+        if pad_mask is not None:
+            mspec = P(dp, cfg.sequence_axis)
+            f = jax.shard_map(
+                lambda a, b, c, m: ring_attention(
+                    a, b, c, axis_name=cfg.sequence_axis, causal=cfg.causal, key_mask=m),
+                mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
+            )
+            return f(q, k, v, pad_mask)
+        f = jax.shard_map(
+            functools.partial(ring_attention, axis_name=cfg.sequence_axis, causal=cfg.causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )
+        return f(q, k, v)
+    impl = cfg.attn_impl if cfg.attn_impl in ("xla", "flash", "auto") else "auto"
+    return dot_product_attention(q, k, v, pad_mask, causal=cfg.causal, impl=impl)
+
+
+def _block(cfg: TransformerConfig, p, h, pad_mask, rng, train):
+    B, T, D = h.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    cd = cfg.compute_dtype
+
+    x = _layer_norm(h, p["ln1_scale"], p["ln1_bias"]).astype(cd)
+    qkv = x @ p["qkv_w"].astype(cd) + p["qkv_b"].astype(cd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    # [B,T,D] -> [B,H,T,hd]
+    q, k, v = (t.reshape(B, T, H, hd).transpose(0, 2, 1, 3) for t in (q, k, v))
+    o = _attention(cfg, q, k, v, pad_mask)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
+    o = o @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
+    o = _dropout(o, cfg, rng, 0, train)
+    h = h + o.astype(h.dtype)
+
+    x = _layer_norm(h, p["ln2_scale"], p["ln2_bias"]).astype(cd)
+    x = jax.nn.gelu(x @ p["ffn_w1"].astype(cd) + p["ffn_b1"].astype(cd))
+    x = x @ p["ffn_w2"].astype(cd) + p["ffn_b2"].astype(cd)
+    x = _dropout(x, cfg, rng, 1, train)
+    return h + x.astype(h.dtype)
+
+
+def _dropout(x, cfg, rng, salt, train):
+    if not train or cfg.dropout <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - cfg.dropout
+    mask = jax.random.bernoulli(jax.random.fold_in(rng, salt), keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+def forward(params, tokens, cfg: TransformerConfig, *, segments=None, pad_mask=None,
+            rng=None, train: bool = False):
+    """tokens [B,T] int32 → logits [B,T,V] (float32)."""
+    B, T = tokens.shape
+    e = params["embed"]
+    h = e["tok"][tokens] + e["pos"][:T][None]
+    if segments is not None:
+        h = h + e["seg"][segments]
+    h = _layer_norm(h, e["ln_scale"], e["ln_bias"]).astype(cfg.compute_dtype)
+
+    block = functools.partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block, static_argnums=())
+    for i, p in enumerate(params["blocks"]):
+        sub = jax.random.fold_in(rng, i) if rng is not None else None
+        h = block(p, h, pad_mask, sub, train)
+
+    m = params["mlm"]
+    x = jax.nn.gelu(h.astype(cfg.compute_dtype) @ m["w"].astype(cfg.compute_dtype)
+                    + m["b"].astype(cfg.compute_dtype))
+    x = _layer_norm(x, m["ln_scale"], m["ln_bias"])
+    # tied output embedding (BERT MLM head)
+    logits = x.astype(jnp.float32) @ params["embed"]["tok"].astype(jnp.float32).T
+    return logits + m["out_bias"].astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: TransformerConfig, rng=None, train: bool = True):
+    """Weighted token cross-entropy — serves masked-LM (weights = mask
+    positions) and causal-LM (weights = all positions) alike."""
+    logits = forward(params, batch["tokens"], cfg, segments=batch.get("segments"),
+                     pad_mask=batch.get("pad_mask"), rng=rng, train=train)
+    labels = batch["labels"]
+    w = batch.get("weights")
+    if w is None:
+        w = jnp.ones(labels.shape, jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * w
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def make_train_step(cfg: TransformerConfig, updater):
+    """One whole-graph XLA train step: loss+grads+updater+apply, donated state."""
+
+    def step(params, opt_state, batch, iteration, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, rng, True)
+        updates, new_opt = updater.apply(grads, opt_state, params, iteration, 0)
+        new_params = jax.tree.map(lambda p, u: (p - u).astype(p.dtype), params, updates)
+        return new_params, new_opt, loss
+
+    return step

@@ -1,0 +1,54 @@
+"""VGG-16 zoo model.
+
+Reference: ``org.deeplearning4j.zoo.model.VGG16`` (SURVEY §2.4 C15) — 13 conv
+layers in 5 blocks + 2 FC(4096) + softmax(1000).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from ..nn.updaters import Nesterovs
+from .zoo import ZooModel
+
+
+class VGG16(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 224, 224)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = input_shape
+
+    def conf(self):
+        c, h, w = self.input_shape
+        b = (
+            NeuralNetConfiguration.Builder()
+            .seed(self.seed)
+            .updater(Nesterovs(1e-2, 0.9))
+            .weight_init("relu")
+            .list()
+        )
+        for n_convs, n_out in ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)):
+            for _ in range(n_convs):
+                b = b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                             convolution_mode="same", activation="relu"))
+            b = b.layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        return (
+            b.layer(DenseLayer(n_out=4096, activation="relu"))
+            .layer(DropoutLayer(dropout=0.5))
+            .layer(DenseLayer(n_out=4096, activation="relu"))
+            .layer(DropoutLayer(dropout=0.5))
+            .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(h, w, c))
+            .build()
+        )

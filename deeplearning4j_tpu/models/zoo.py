@@ -1,0 +1,120 @@
+"""Model zoo base + small CNNs.
+
+Reference: ``deeplearning4j-zoo`` — ``org.deeplearning4j.zoo.ZooModel`` SPI
+(``init()``, ``pretrainedUrl()``, ``initPretrained()``) and
+``org.deeplearning4j.zoo.model.{LeNet, SimpleCNN, …}`` (SURVEY §2.4 C15).
+Pretrained download is stubbed (zero-egress environment): ``init_pretrained``
+loads from a local path when given one, else raises.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..nn.conf import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from ..nn.multilayer import MultiLayerNetwork
+from ..nn.updaters import Adam, Nesterovs
+
+
+class ZooModel:
+    """org.deeplearning4j.zoo.ZooModel SPI."""
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        net = self._net_class()(self.conf())
+        net.init()
+        return net
+
+    def _net_class(self):
+        return MultiLayerNetwork
+
+    def pretrained_url(self, dataset: str = "imagenet") -> Optional[str]:
+        return None  # zero-egress build: no download URLs
+
+    def init_pretrained(self, path: Optional[str] = None):
+        if path is None:
+            raise ValueError(
+                "no pretrained weights available in this environment; pass a "
+                "local checkpoint path (ModelSerializer zip)")
+        from ..serde.model_serializer import ModelSerializer
+
+        return ModelSerializer.restore(path)
+
+
+class LeNet(ZooModel):
+    """org.deeplearning4j.zoo.model.LeNet — BASELINE config #1 (LeNet MNIST)."""
+
+    def __init__(self, num_classes: int = 10, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (1, 28, 28)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = input_shape
+
+    def conf(self):
+        c, h, w = self.input_shape
+        return (
+            NeuralNetConfiguration.Builder()
+            .seed(self.seed)
+            .updater(Adam(1e-3))
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                    convolution_mode="same", activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                    convolution_mode="same", activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(h, w, c))
+            .build()
+        )
+
+
+class SimpleCNN(ZooModel):
+    """org.deeplearning4j.zoo.model.SimpleCNN (4 conv blocks + dense)."""
+
+    def __init__(self, num_classes: int = 10, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 48, 48)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = input_shape
+
+    def conf(self):
+        c, h, w = self.input_shape
+        b = (
+            NeuralNetConfiguration.Builder()
+            .seed(self.seed)
+            .updater(Nesterovs(5e-3, 0.9))
+            .weight_init("xavier")
+            .list()
+        )
+        for n_out in (32, 64, 128, 256):
+            b = (
+                b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                         convolution_mode="same", activation="identity"))
+                .layer(BatchNormalization())
+                .layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                        convolution_mode="same", activation="relu"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+            )
+        return (
+            b.layer(DenseLayer(n_out=512, activation="relu"))
+            .layer(DropoutLayer(dropout=0.5))
+            .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(h, w, c))
+            .build()
+        )

@@ -982,6 +982,27 @@ class MultiLayerConfiguration:
         """Per-layer input InputType after preprocessor application."""
         its = []
         it = self.input_type
+        if it is None and self.layers:
+            # DL4J allows omitting setInputType when the first layer declares
+            # nIn explicitly — synthesize the InputType from it
+            first = self.layers[0]
+            if isinstance(first, Bidirectional):
+                n_in = getattr(first.fwd, "n_in", 0)
+                if n_in:
+                    it = InputType.recurrent(n_in)
+            elif isinstance(first, (ConvolutionLayer, SubsamplingLayer, Upsampling2D,
+                                    ZeroPaddingLayer, LocalResponseNormalization)):
+                # nIn alone cannot recover spatial dims for CNN inputs
+                raise ValueError(
+                    "first layer is convolutional: call "
+                    ".set_input_type(InputType.convolutional(h, w, c))")
+            else:
+                n_in = getattr(first, "n_in", 0)
+                if n_in:
+                    if isinstance(first, (LSTM, SimpleRnn, EmbeddingSequenceLayer)):
+                        it = InputType.recurrent(n_in)
+                    else:
+                        it = InputType.feed_forward(n_in)
         for i, layer in enumerate(self.layers):
             if i in self.preprocessors:
                 it = self.preprocessors[i].output_type(it)

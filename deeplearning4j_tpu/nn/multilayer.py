@@ -80,6 +80,16 @@ class MultiLayerNetwork:
         self._input_types = conf.input_types()
         self._dtype = to_jax(conf.dtype)
         self._jit_cache: Dict[str, Any] = {}
+        # optional placement hook for minibatch arrays (ParallelTrainer sets
+        # this to a mesh-sharding device_put so the SAME fit paths — incl.
+        # tbptt — run data-parallel)
+        self._input_put = None
+
+    def _put(self, arr, dtype=None):
+        if arr is None:
+            return None
+        a = jnp.asarray(arr, dtype) if dtype is not None else jnp.asarray(arr)
+        return self._input_put(a) if self._input_put is not None else a
 
     # ------------------------------------------------------------------ init
 
@@ -234,10 +244,10 @@ class MultiLayerNetwork:
             return
         step = self._train_step_fn()
         rng = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self.iteration)
-        x = jnp.asarray(ds.features, self._dtype)
-        y = jnp.asarray(ds.labels)
-        fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-        lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        x = self._put(ds.features, self._dtype)
+        y = self._put(ds.labels)
+        fmask = self._put(ds.features_mask)
+        lmask = self._put(ds.labels_mask)
         self.params_, self.updater_state, self.bn_state, loss = step(
             self.params_, self.updater_state, self.bn_state,
             jnp.asarray(self.iteration, jnp.int32), jnp.asarray(self.epoch, jnp.int32),
@@ -262,7 +272,7 @@ class MultiLayerNetwork:
         rnn_states = self._zero_rnn_states(B)
         fmask_all = None if ds.features_mask is None else np.asarray(ds.features_mask)
         lmask_all = None if ds.labels_mask is None else np.asarray(ds.labels_mask)
-        loss_weighted, weight_total = 0.0, 0.0
+        loss_weighted, weight_total = [], 0.0
         for seg_start in range(0, T, fwd):
             seg = slice(seg_start, min(seg_start + fwd, T))
             seg_len = seg.stop - seg.start
@@ -284,15 +294,17 @@ class MultiLayerNetwork:
             self.params_, self.updater_state, self.bn_state, rnn_states, loss = step(
                 self.params_, self.updater_state, self.bn_state, rnn_states,
                 jnp.asarray(self.iteration, jnp.int32), jnp.asarray(self.epoch, jnp.int32),
-                jnp.asarray(x_seg, self._dtype), jnp.asarray(y_seg),
-                None if fm is None else jnp.asarray(fm), jnp.asarray(lm), rng,
+                self._put(x_seg, self._dtype), self._put(y_seg),
+                self._put(fm), self._put(lm), rng,
             )
+            # accumulate device-side: one host sync per fit, not per segment
             w = float(np.sum(lm))
-            loss_weighted += float(loss) * w
+            loss_weighted.append(loss * w)
             weight_total += w
         # fit-wide score = unmasked-timestep-weighted mean over segments (the
         # reference reports one score per fit call, not per tbptt segment)
-        self.score_ = loss_weighted / weight_total if weight_total > 0 else float(loss)
+        total = float(sum(loss_weighted[1:], loss_weighted[0]))
+        self.score_ = total / weight_total if weight_total > 0 else float(loss)
         self.iteration += 1
         for lst in self.listeners:
             if hasattr(lst, "iteration_done"):
@@ -439,9 +451,11 @@ class MultiLayerNetwork:
     setListeners = add_listeners
 
     def clone(self) -> "MultiLayerNetwork":
+        # deep-copy buffers: the train step donates state, so replicas must
+        # not alias (a donated buffer is deleted under every alias)
         m = MultiLayerNetwork(self.conf)
         m.init()
-        m.params_ = jax.tree.map(lambda x: x, self.params_)
-        m.bn_state = jax.tree.map(lambda x: x, self.bn_state)
-        m.updater_state = jax.tree.map(lambda x: x, self.updater_state)
+        m.params_ = jax.tree.map(jnp.copy, self.params_)
+        m.bn_state = jax.tree.map(jnp.copy, self.bn_state)
+        m.updater_state = jax.tree.map(jnp.copy, self.updater_state)
         return m

@@ -3,7 +3,7 @@ from .sharding import ShardingRules, DP, TP_COLUMN, TP_ROW, replicated, shard_ba
 from .trainer import ParallelTrainer, ParameterAveragingTrainingMaster, SharedTrainingMaster
 from .wrapper import ParallelWrapper
 from .inference import ParallelInference
-from . import collectives
+from . import collectives, compression
 
 __all__ = [
     "MeshSpec",

@@ -1,0 +1,163 @@
+"""Collectives facade + in-process fake with failure/delay injection.
+
+Reference parity (SURVEY §5.8): the reference's data plane is an Aeron UDP
+mesh with a ``Transport`` SPI whose test impl is ``DummyTransport`` (an
+in-memory router with disconnect simulation and a ``DelayedDummyTransport``
+latency variant). Here the production data plane is XLA collectives compiled
+into the step (psum/all_gather/ppermute/all_to_all over ICI), and the SPI +
+fake pattern is preserved for the HOST-side control plane: the
+``Collectives`` facade has (a) a jax impl and (b) ``FakeCollectives`` with
+injectable delay and failure for testing restore paths (SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ------------------------------------------------- in-step (compiled) wrappers
+
+def psum(x, axis_name: str):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ppermute(x, axis_name: str, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+
+
+# ------------------------------------------------------ host-side control SPI
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+class Collectives:
+    """Host-side control-plane SPI (barrier / broadcast / gather of small
+    blobs between processes). Analog of the reference Transport SPI
+    (``v2.transport.Transport``: send/propagate/onReceive)."""
+
+    def barrier(self, name: str) -> None:
+        raise NotImplementedError
+
+    def broadcast(self, name: str, value: Any, root: int = 0) -> Any:
+        raise NotImplementedError
+
+    def gather(self, name: str, value: Any, root: int = 0) -> Optional[List[Any]]:
+        raise NotImplementedError
+
+
+class SingleProcessCollectives(Collectives):
+    """Trivial impl for one-process runs (the common single-host case)."""
+
+    def barrier(self, name: str) -> None:
+        return None
+
+    def broadcast(self, name: str, value: Any, root: int = 0) -> Any:
+        return value
+
+    def gather(self, name: str, value: Any, root: int = 0):
+        return [value]
+
+
+class FakeCollectives(Collectives):
+    """In-process multi-"worker" fake — the DummyTransport descendant.
+
+    N logical workers share one router object; each worker thread gets a
+    handle via ``worker(rank)``. ``inject_delay(rank, seconds)`` and
+    ``inject_failure(rank)`` simulate slow and dead hosts; operations
+    involving a failed rank raise TransportError on every live rank, which is
+    exactly the gang-scheduled TPU failure model (whole-step abort →
+    checkpoint restore, SURVEY §5.3).
+    """
+
+    def __init__(self, world_size: int, timeout: float = 10.0):
+        self.world_size = world_size
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._slots: Dict[str, Dict[int, Any]] = {}
+        self._complete: set = set()  # latched: names whose rendezvous finished
+        self._delays: Dict[int, float] = {}
+        self._failed: set = set()
+
+    def inject_delay(self, rank: int, seconds: float) -> None:
+        self._delays[rank] = seconds
+
+    def inject_failure(self, rank: int) -> None:
+        with self._cond:
+            self._failed.add(rank)
+            # invalidate the dead rank's deposits: any collective it hadn't
+            # fully completed must abort for the survivors (already-returned
+            # collectives handed out copies and are unaffected)
+            for name, slot in self._slots.items():
+                if name not in self._complete:
+                    slot.pop(rank, None)
+            self._cond.notify_all()
+
+    def worker(self, rank: int) -> "FakeWorkerCollectives":
+        return FakeWorkerCollectives(self, rank)
+
+    # internal rendezvous: every live rank deposits; waits for all live ranks
+    def _rendezvous(self, name: str, rank: int, value: Any) -> Dict[int, Any]:
+        delay = self._delays.get(rank, 0.0)
+        if delay:
+            time.sleep(delay)
+        deadline = time.monotonic() + self.timeout
+        with self._cond:
+            if rank in self._failed:
+                raise TransportError(f"rank {rank} is failed")
+            slot = self._slots.setdefault(name, {})
+            slot[rank] = value
+            self._cond.notify_all()
+            while True:
+                # completeness first (and latched): a failure injected after
+                # every rank deposited must not abort the finished collective,
+                # even for ranks that have not woken yet
+                if name in self._complete or set(range(self.world_size)).issubset(slot.keys()):
+                    self._complete.add(name)
+                    return dict(slot)
+                if self._failed:
+                    # gang-scheduled semantics: any failed member aborts the
+                    # collective for EVERY rank (whole-step abort → restore)
+                    raise TransportError(f"ranks {sorted(self._failed)} failed during '{name}'")
+                if not self._cond.wait(timeout=max(0.0, deadline - time.monotonic())):
+                    raise TransportError(f"timeout in '{name}' (have {sorted(slot)}, "
+                                         f"need {self.world_size} ranks)")
+
+
+class FakeWorkerCollectives(Collectives):
+    def __init__(self, router: FakeCollectives, rank: int):
+        self.router = router
+        self.rank = rank
+
+    def barrier(self, name: str) -> None:
+        self.router._rendezvous(name, self.rank, None)
+
+    def broadcast(self, name: str, value: Any, root: int = 0) -> Any:
+        slot = self.router._rendezvous(name, self.rank, value)
+        return slot[root]
+
+    def gather(self, name: str, value: Any, root: int = 0):
+        slot = self.router._rendezvous(name, self.rank, value)
+        if self.rank == root:
+            return [slot[i] for i in sorted(slot)]
+        return None

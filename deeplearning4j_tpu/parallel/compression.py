@@ -1,0 +1,88 @@
+"""Gradient compression: threshold + bitmap encoding (reference N15 parity).
+
+Reference: libnd4j ``encodeThresholdP1/P2/P3``, ``encodeBitmap``,
+``decodeThreshold``, ``decodeBitmap`` (NativeOps.h, SURVEY §2.1 N15) — the
+sparse {index,sign} update format the Aeron gradient-sharing mesh ships
+between workers, with residual accumulation handled by
+``EncodedGradientsAccumulator`` (§2.4 C7).
+
+On a TPU pod the synchronous ICI allreduce is faster than any sparse async
+scheme, so these codecs are NOT in the compiled step; they exist for (a) API
+parity, (b) the optional cross-slice DCN path where bandwidth is scarce
+(SURVEY §2.9 N15 mapping).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def threshold_encode(grad: np.ndarray, threshold: float) -> np.ndarray:
+    """Sparse {signed index} encoding: int32 array [n, idx0±, idx1±, ...]
+    where sign of entry encodes update direction and magnitude==threshold.
+    Mirrors libnd4j's threshold format (header + signed indices)."""
+    flat = np.asarray(grad).reshape(-1)
+    idx = np.nonzero(np.abs(flat) >= threshold)[0]
+    signs = np.sign(flat[idx]).astype(np.int32)
+    # index+1 so sign survives index 0
+    encoded = ((idx.astype(np.int64) + 1) * signs).astype(np.int64)
+    return np.concatenate([[flat.size], encoded]).astype(np.int64)
+
+
+def threshold_decode(encoded: np.ndarray, threshold: float) -> np.ndarray:
+    size = int(encoded[0])
+    out = np.zeros(size, np.float32)
+    body = encoded[1:]
+    idx = np.abs(body) - 1
+    out[idx] = np.sign(body) * threshold
+    return out
+
+
+def threshold_residual(grad: np.ndarray, threshold: float) -> Tuple[np.ndarray, np.ndarray]:
+    """encode + residual (grad - decoded), the accumulator loop of C7."""
+    enc = threshold_encode(grad, threshold)
+    dec = threshold_decode(enc, threshold).reshape(np.shape(grad))
+    return enc, np.asarray(grad, np.float32) - dec
+
+
+def bitmap_encode(grad: np.ndarray, threshold: float) -> Tuple[np.ndarray, np.ndarray]:
+    """Dense 2-bit-per-element encoding (libnd4j encodeBitmap): 0 = |g|<t,
+    1 = +t, 2 = -t. Wins over threshold encoding when >~1/16 of entries
+    exceed the threshold."""
+    flat = np.asarray(grad).reshape(-1)
+    codes = np.zeros(flat.size, np.uint8)
+    codes[flat >= threshold] = 1
+    codes[flat <= -threshold] = 2
+    packed = np.packbits(np.unpackbits(codes.reshape(-1, 1), axis=1, count=2, bitorder="little"),
+                         bitorder="little")
+    return packed, np.asarray([flat.size], np.int64)
+
+
+def bitmap_decode(packed: np.ndarray, size_arr: np.ndarray, threshold: float) -> np.ndarray:
+    size = int(size_arr[0])
+    bits = np.unpackbits(packed, bitorder="little")[: size * 2]
+    codes = bits.reshape(-1, 2)
+    vals = codes[:, 0].astype(np.float32) * threshold - codes[:, 1].astype(np.float32) * threshold
+    return vals
+
+
+class AdaptiveThresholdAlgorithm:
+    """org.deeplearning4j...encoding.ThresholdAlgorithm (adaptive variant):
+    adjust threshold toward a target update sparsity."""
+
+    def __init__(self, initial: float = 1e-3, target_sparsity: float = 1e-3,
+                 decay: float = 1.05):
+        self.threshold = initial
+        self.target = target_sparsity
+        self.decay = decay
+
+    def update(self, grad: np.ndarray) -> float:
+        flat = np.asarray(grad).reshape(-1)
+        sparsity = np.mean(np.abs(flat) >= self.threshold)
+        if sparsity > self.target * 2:
+            self.threshold *= self.decay
+        elif sparsity < self.target / 2:
+            self.threshold /= self.decay
+        return self.threshold

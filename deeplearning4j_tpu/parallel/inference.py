@@ -1,0 +1,67 @@
+"""ParallelInference — batched multi-device inference.
+
+Reference: ``org.deeplearning4j.parallelism.ParallelInference`` (SURVEY §2.6
+S5): per-device model replicas, request batching, load balancing. TPU
+inversion: ONE compiled forward sharded over the mesh data axis replaces the
+replica pool; "batching" = padding requests up to a bucketed batch size so
+the executable cache stays warm (SURVEY §7.2 hard part #3).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DATA, build_mesh
+
+
+class ParallelInference:
+    def __init__(self, model, mesh=None, batch_limit: int = 32, workers: Optional[int] = None):
+        self.model = model
+        devs = jax.devices()[: workers] if workers else None
+        self.mesh = mesh or build_mesh(data=-1, devices=devs)
+        self.batch_limit = batch_limit
+        self._ndata = self.mesh.shape[AXIS_DATA]
+        # replicate model state on the mesh once
+        rep = NamedSharding(self.mesh, P())
+        model.params_ = jax.device_put(model.params_, rep)
+        model.bn_state = jax.device_put(model.bn_state, rep)
+
+    def _bucket(self, n: int) -> int:
+        """Smallest power-of-2 multiple of the data-axis size that fits n —
+        always divisible by the mesh, always >= n; batch_limit only seeds the
+        smallest bucket so tiny requests share one executable."""
+        b = self._ndata
+        while b < self.batch_limit:
+            b *= 2
+        while b < n:
+            b *= 2
+        return b
+
+    def output(self, x) -> np.ndarray:
+        """Pad to a bucketed batch size, run the sharded forward, trim."""
+        arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+        n = arr.shape[0]
+        bucket = self._bucket(n)
+        if n < bucket:
+            pad = np.zeros((bucket - n,) + arr.shape[1:], arr.dtype)
+            arr = np.concatenate([arr, pad], axis=0)
+        spec = P(AXIS_DATA, *([None] * (arr.ndim - 1)))
+        xs = jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
+        out = self.model.output(xs)
+        return np.asarray(out.numpy() if hasattr(out, "numpy") else out)[:n]
+
+    def output_batched(self, xs: List[np.ndarray]) -> List[np.ndarray]:
+        """Service a list of requests as one padded batch (request batching)."""
+        sizes = [np.asarray(x).shape[0] for x in xs]
+        big = np.concatenate([np.asarray(x) for x in xs], axis=0)
+        out = self.output(big)
+        res, off = [], 0
+        for s in sizes:
+            res.append(out[off : off + s])
+            off += s
+        return res

@@ -1,0 +1,106 @@
+"""Sharding rules: map param pytrees → PartitionSpecs.
+
+Reference inversion (SURVEY §2.10): the reference has NO tensor parallelism
+— nothing shards a single layer's math. Here layer params get Megatron-style
+column/row splits expressed as ``PartitionSpec``s; GSPMD inserts the ICI
+collectives. The rule objects play the role the reference's
+``ParallelWrapper`` configuration plays for DP — a declarative description
+of how a network spreads over devices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DATA, AXIS_MODEL
+
+# Strategy tags for per-param rules
+DP = "dp"               # replicate params, shard batch (pure data parallel)
+TP_COLUMN = "tp_column"  # split output features over the model axis
+TP_ROW = "tp_row"        # split input features over the model axis
+
+
+def replicated() -> P:
+    return P()
+
+
+def _spec_for(w, strategy: str, model_axis: str) -> P:
+    if strategy == DP or w.ndim == 0:
+        return P()
+    if strategy == TP_COLUMN:
+        # last dim = output features for [in,out] dense kernels; 1-D bias
+        # follows its features
+        return P(*([None] * (w.ndim - 1) + [model_axis]))
+    if strategy == TP_ROW:
+        if w.ndim == 1:
+            return P()  # bias of a row-split layer is replicated (added post-psum)
+        return P(*([model_axis] + [None] * (w.ndim - 1)))
+    raise ValueError(strategy)
+
+
+class ShardingRules:
+    """Per-param strategy table with a default, evaluated over a param tree.
+
+    ``rule_fn(path, leaf) -> strategy|P`` overrides; paths are '/'-joined key
+    sequences (e.g. ``"3/W"`` for MLN layer 3 kernel).
+    """
+
+    def __init__(self, default: str = DP,
+                 rule_fn: Optional[Callable[[str, Any], Any]] = None,
+                 model_axis: str = AXIS_MODEL):
+        self.default = default
+        self.rule_fn = rule_fn
+        self.model_axis = model_axis
+
+    def spec_tree(self, params) -> Any:
+        def spec(path, leaf):
+            pstr = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+            rule = self.rule_fn(pstr, leaf) if self.rule_fn else None
+            if rule is None:
+                rule = self.default
+            if isinstance(rule, P):
+                return rule
+            return _spec_for(leaf, rule, self.model_axis)
+
+        return jax.tree_util.tree_map_with_path(spec, params)
+
+    def shard_tree(self, params, mesh: Mesh):
+        specs = self.spec_tree(params)
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(params, shardings), specs
+
+
+def alternating_dense_rules(model_axis: str = AXIS_MODEL) -> ShardingRules:
+    """Megatron pairing for MLN stacks: even dense layers column-split, odd
+    row-split, so activations stay sharded through pairs with a single
+    all-reduce per pair."""
+
+    def rule(path: str, leaf):
+        parts = path.split("/")
+        if len(parts) >= 2 and parts[-1] in ("W", "b") and parts[0].isdigit():
+            return TP_COLUMN if int(parts[0]) % 2 == 0 else TP_ROW
+        return DP
+
+    return ShardingRules(default=DP, rule_fn=rule, model_axis=model_axis)
+
+
+def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """Place a param tree on a mesh per rules (default: replicate)."""
+    rules = rules or ShardingRules()
+    placed, _ = rules.shard_tree(params, mesh)
+    return placed
+
+
+def shard_batch(batch, mesh: Mesh, data_axis: str = AXIS_DATA):
+    """Shard leading (batch) dim of every leaf over the data axis."""
+
+    def put(x):
+        spec = P(data_axis, *([None] * (np.ndim(x) - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, batch)

@@ -1,0 +1,83 @@
+"""ParallelWrapper — single-host multi-device data-parallel fit.
+
+Reference: ``org.deeplearning4j.parallelism.ParallelWrapper`` (SURVEY §2.6
+S4): model replica per device thread, ``CudaAffinityManager`` pins threads to
+GPUs, periodic param averaging OR encoded gradient sharing. TPU inversion:
+one SPMD program over the local mesh — replicas, affinity threads, MagicQueue
+prefetch, and the accumulator all collapse into the sharded compiled step
+(gradients allreduce over ICI every step, which is the averaging_frequency=1
+limit of the reference and converges at least as well).
+
+The Builder API is kept; ``averaging_frequency > 1`` selects the
+ParameterAveragingTrainingMaster emulation for semantics parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .mesh import build_mesh
+from .trainer import ParallelTrainer, ParameterAveragingTrainingMaster
+
+
+class ParallelWrapper:
+    def __init__(self, model, workers: Optional[int] = None,
+                 prefetch_buffer: int = 2, averaging_frequency: int = 1,
+                 report_score_after_averaging: bool = True,
+                 training_mode: str = "SHARED_GRADIENTS"):
+        self.model = model
+        self.workers = workers or len(jax.devices())
+        self.prefetch_buffer = prefetch_buffer
+        self.averaging_frequency = averaging_frequency
+        self.training_mode = training_mode
+
+    def fit(self, iterator, epochs: int = 1):
+        from ..data.iterators import AsyncDataSetIterator, DataSetIterator
+
+        if self.prefetch_buffer > 0 and isinstance(iterator, DataSetIterator) and not isinstance(
+            iterator, AsyncDataSetIterator
+        ):
+            iterator = AsyncDataSetIterator(iterator, queue_size=self.prefetch_buffer)
+        if self.training_mode == "AVERAGING" and self.averaging_frequency > 1:
+            master = ParameterAveragingTrainingMaster(
+                workers=self.workers, averaging_frequency=self.averaging_frequency)
+            return master.fit(self.model, iterator, epochs)
+        trainer = ParallelTrainer(
+            self.model, mesh=build_mesh(data=self.workers,
+                                        devices=jax.devices()[: self.workers]))
+        return trainer.fit(iterator, epochs)
+
+    def shutdown(self):
+        return None
+
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._kw = {}
+
+        def workers(self, n: int):
+            self._kw["workers"] = n
+            return self
+
+        def prefetch_buffer(self, n: int):
+            self._kw["prefetch_buffer"] = n
+            return self
+
+        prefetchBuffer = prefetch_buffer
+
+        def averaging_frequency(self, n: int):
+            self._kw["averaging_frequency"] = n
+            return self
+
+        averagingFrequency = averaging_frequency
+
+        def training_mode(self, mode: str):
+            self._kw["training_mode"] = mode
+            return self
+
+        trainingMode = training_mode
+
+        def build(self) -> "ParallelWrapper":
+            return ParallelWrapper(self._model, **self._kw)

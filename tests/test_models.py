@@ -1,0 +1,121 @@
+"""Zoo + flagship transformer tests (SURVEY §2.4 C15, §3.3)."""
+
+import importlib.util
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.models import (
+    LeNet,
+    ResNet50,
+    TextGenerationLSTM,
+    TransformerConfig,
+    transformer_init,
+    transformer_loss,
+    transformer_partition_specs,
+)
+from deeplearning4j_tpu.models.transformer import forward, make_train_step
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def test_lenet_trains():
+    net = LeNet().init()
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, 8)]
+    s0 = None
+    for _ in range(3):
+        net.fit(DataSet(x, y))
+        s0 = s0 or net.score_
+    assert net.score_ < s0  # loss decreases on the fixed batch
+    assert net.num_params() == 1256080
+
+
+def test_resnet50_builds_and_steps():
+    net = ResNet50(num_classes=10, input_shape=(3, 32, 32)).init()
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 32, 32).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, 2)]
+    net.fit(DataSet(x, y))
+    assert np.isfinite(net.score_)
+
+
+def test_resnet50_imagenet_param_count():
+    conf = ResNet50(num_classes=1000).conf()
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    net = ComputationGraph(conf)
+    net.init()
+    n = sum(int(np.prod(w.shape)) for lp in net.params_.values() for w in lp.values())
+    # Keras/dl4j-zoo ResNet50 reports 25,636,712 at 1000 classes, which counts
+    # conv biases (26,560) and BN moving mean/var (53,120). This build uses
+    # bias-free convs into BN (standard) and keeps BN stats as non-param state:
+    # 25,636,712 - 26,560 - 53,120 = 25,557,032 trainable parameters.
+    assert n == 25_557_032
+
+
+def test_char_lstm_tbptt_trains():
+    net = TextGenerationLSTM(vocab_size=12, hidden=16, layers=1, tbptt_length=8).init()
+    rs = np.random.RandomState(0)
+    x = np.eye(12, dtype=np.float32)[rs.randint(0, 12, (2, 20))].transpose(0, 2, 1)
+    y = np.eye(12, dtype=np.float32)[rs.randint(0, 12, (2, 20))].transpose(0, 2, 1)
+    net.fit(DataSet(x, y))
+    assert np.isfinite(net.score_)
+
+
+def test_transformer_dp_tp_train_step():
+    cfg = TransformerConfig.tiny()
+    params = transformer_init(jax.random.key(0), cfg)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+    specs = transformer_partition_specs(cfg)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                          is_leaf=lambda x: isinstance(x, P))
+    params = jax.device_put(params, pshard)
+    upd = Adam(1e-3)
+    opt = upd.init(params)
+    rs = np.random.RandomState(0)
+    toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 128)), jnp.int32)
+    batch = {"tokens": toks, "labels": toks,
+             "weights": jnp.ones((8, 128), jnp.float32)}
+    batch = {k: jax.device_put(v, NamedSharding(mesh, P("dp", None)))
+             for k, v in batch.items()}
+    step = jax.jit(make_train_step(cfg, upd), donate_argnums=(0, 1))
+    with jax.sharding.set_mesh(mesh):
+        params, opt, loss = step(params, opt, batch, jnp.asarray(0, jnp.int32),
+                                 jax.random.key(1))
+    assert np.isfinite(float(loss))
+
+
+def test_transformer_ring_loss_matches_xla():
+    """Sequence-parallel ring attention path computes the same loss."""
+    cfg_x = TransformerConfig.tiny(dropout=0.0)
+    cfg_r = TransformerConfig.tiny(dropout=0.0, attn_impl="ring", sequence_axis="sp")
+    params = transformer_init(jax.random.key(0), cfg_x)
+    rs = np.random.RandomState(0)
+    toks = jnp.asarray(rs.randint(0, cfg_x.vocab_size, (4, 128)), jnp.int32)
+    batch = {"tokens": toks, "labels": toks, "weights": jnp.ones((4, 128), jnp.float32)}
+    l_ref = float(transformer_loss(params, batch, cfg_x, None, False))
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2), ("dp", "tp", "sp"))
+    specs = transformer_partition_specs(cfg_r)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                          is_leaf=lambda x: isinstance(x, P))
+    params_s = jax.device_put(params, pshard)
+    batch_s = {k: jax.device_put(v, NamedSharding(mesh, P("dp", "sp")))
+               for k, v in batch.items()}
+    with jax.sharding.set_mesh(mesh):
+        l_ring = float(jax.jit(lambda p, b: transformer_loss(p, b, cfg_r, None, False))(
+            params_s, batch_s))
+    assert abs(l_ref - l_ring) < 1e-3
+
+
+def test_graft_entry():
+    root = pathlib.Path(__file__).resolve().parents[1]
+    spec = importlib.util.spec_from_file_location("graft_entry", root / "__graft_entry__.py")
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    m.dryrun_multichip(8)

@@ -1,0 +1,145 @@
+"""Distributed layer tests on the 8-device CPU mesh (SURVEY §4.4/§4.6 #5 —
+the TPU analog of local[N] Spark + DummyTransport)."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel import (
+    ParallelInference,
+    ParallelTrainer,
+    ParallelWrapper,
+    ParameterAveragingTrainingMaster,
+    build_mesh,
+    compression,
+)
+from deeplearning4j_tpu.parallel.collectives import FakeCollectives, TransportError
+
+
+def _mlp(seed=7, lr=0.05):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Sgd(lr)).list()
+            .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _data(n=64, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 6).astype(np.float32)
+    y = np.argmax(X[:, :3], axis=1)
+    return X, np.eye(3, dtype=np.float32)[y]
+
+
+def test_parallel_trainer_matches_single_device():
+    """Sync DP over the mesh must equal the single-device step bitwise-close
+    (same global batch, grads are a mean either way)."""
+    X, Y = _data(32)
+    ds = DataSet(X, Y)
+    a, b = _mlp(), _mlp()
+    a._fit_batch(ds)
+    trainer = ParallelTrainer(b, mesh=build_mesh(data=8))
+    trainer._fit_batch(ds)
+    fa, fb = a.params().numpy(), b.params().numpy()
+    np.testing.assert_allclose(fa, fb, atol=1e-5)
+
+
+def test_parallel_trainer_remainder_batch():
+    X, Y = _data(30)  # 30 % 8 != 0 → trim + remainder path
+    net = _mlp()
+    ParallelTrainer(net, mesh=build_mesh(data=8))._fit_batch(DataSet(X, Y))
+    assert np.isfinite(net.score_)
+    assert net.iteration == 2  # main shard + remainder
+
+
+def test_parallel_wrapper_trains():
+    X, Y = _data(64)
+    net = _mlp()
+    w = (ParallelWrapper.Builder(net).workers(8).prefetch_buffer(2).build())
+    it = ListDataSetIterator([DataSet(X[i:i + 16], Y[i:i + 16]) for i in range(0, 64, 16)])
+    s0 = None
+    for _ in range(10):
+        w.fit(it)
+        s0 = s0 or net.score_
+    assert net.score_ < s0
+
+
+def test_parameter_averaging_master():
+    X, Y = _data(64)
+    net = _mlp()
+    master = ParameterAveragingTrainingMaster(workers=4, averaging_frequency=2)
+    it = ListDataSetIterator([DataSet(X[i:i + 8], Y[i:i + 8]) for i in range(0, 64, 8)])
+    master.fit(net, it, epochs=3)
+    assert np.isfinite(net.score_)
+
+
+def test_parallel_inference_pads_and_trims():
+    net = _mlp()
+    pi = ParallelInference(net, batch_limit=16)
+    X, _ = _data(5)  # 5 not divisible by 8 → padded to bucket, trimmed back
+    out = pi.output(X)
+    assert out.shape == (5, 3)
+    ref = net.output(X).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_threshold_codec_roundtrip():
+    rs = np.random.RandomState(3)
+    g = rs.randn(1000).astype(np.float32) * 1e-3
+    enc, residual = compression.threshold_residual(g, 1e-3)
+    dec = compression.threshold_decode(enc, 1e-3)
+    # decode+residual reconstructs g exactly
+    np.testing.assert_allclose(dec + residual, g, atol=1e-7)
+    # decoded entries only at |g| >= t, with sign preserved
+    idx = np.nonzero(dec)[0]
+    assert np.all(np.abs(g[idx]) >= 1e-3)
+    assert np.all(np.sign(dec[idx]) == np.sign(g[idx]))
+
+
+def test_bitmap_codec_roundtrip():
+    rs = np.random.RandomState(4)
+    g = rs.randn(257).astype(np.float32) * 2e-3
+    packed, size = compression.bitmap_encode(g, 1e-3)
+    dec = compression.bitmap_decode(packed, size, 1e-3)
+    assert dec.shape == g.shape
+    exp = np.where(g >= 1e-3, 1e-3, np.where(g <= -1e-3, -1e-3, 0.0)).astype(np.float32)
+    np.testing.assert_allclose(dec, exp, atol=1e-8)
+
+
+def test_fake_collectives_barrier_broadcast_and_failure():
+    """DummyTransport-descendant: normal ops + injected failure aborts all."""
+    router = FakeCollectives(world_size=3, timeout=5.0)
+    results, errors = {}, {}
+
+    def run(rank):
+        w = router.worker(rank)
+        try:
+            w.barrier("start")
+            results[rank] = w.broadcast("conf", {"lr": 0.1} if rank == 0 else None)
+            g = w.gather("scores", rank * 1.0)
+            if rank == 0:
+                results["gathered"] = g
+            if rank == 1:
+                router.inject_failure(2)
+            w.barrier("end")  # rank 2 is failed → everyone gets TransportError
+        except TransportError as e:
+            errors[rank] = e
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results[1] == {"lr": 0.1} and results[2] == {"lr": 0.1}
+    assert results["gathered"] == [0.0, 1.0, 2.0]
+    assert 0 in errors and 1 in errors  # live ranks observed the failure
