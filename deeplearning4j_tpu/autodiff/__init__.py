@@ -1,0 +1,18 @@
+"""SameDiff-parity define-then-run autodiff graph.
+
+Reference: ``org.nd4j.autodiff.samediff.SameDiff`` (~6.5k LoC, SURVEY §2.2
+J11-J15): variable registry (VARIABLE/CONSTANT/PLACEHOLDER/ARRAY), op graph,
+lazy grad-graph via per-op ``doDiff``, op-by-op interpreted execution
+(``InferenceSession`` — ~1.2k JNI round-trips per BERT step, SURVEY §3.3),
+FlatBuffers serialization.
+
+TPU inversion (SURVEY §2.9 N11): the graph lowers ONCE to a single XLA
+executable per placeholder-shape signature — ``sd.output``/``sd.fit`` run
+whole-graph compiled. Reverse-mode autodiff is jax.grad over the traced
+graph function, so no per-op doDiff corpus is needed; the op registry is
+serialization vocabulary, not a dispatch table.
+"""
+
+from .samediff import SDVariable, SameDiff, TrainingConfig, VariableType
+
+__all__ = ["SameDiff", "SDVariable", "TrainingConfig", "VariableType"]

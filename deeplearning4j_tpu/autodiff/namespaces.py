@@ -1,0 +1,205 @@
+"""Op namespaces: sd.math()/nn()/cnn()/rnn()/loss()/linalg().
+
+Reference: generated ``org.nd4j.autodiff.samediff.ops.{SDMath, SDNN, SDCNN,
+SDRNN, SDLoss, SDLinalg}`` (SURVEY §2.2 J11; §2.8 codegen-tools note — the
+reference generates these from an op DSL, which is why they look mechanical;
+here they are thin typed veneers over the ops registry).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .samediff import SameDiff, SDVariable
+
+
+class _NS:
+    def __init__(self, sd: SameDiff):
+        self.sd = sd
+
+    def _o(self, op, *xs, name=None, n_outputs=1, **kw):
+        return self.sd.op(op, *xs, name=name, n_outputs=n_outputs, **kw)
+
+
+class SDMath(_NS):
+    def abs(self, x, name=None):
+        return self._o("abs", x, name=name)
+
+    def exp(self, x, name=None):
+        return self._o("exp", x, name=name)
+
+    def log(self, x, name=None):
+        return self._o("log", x, name=name)
+
+    def sqrt(self, x, name=None):
+        return self._o("sqrt", x, name=name)
+
+    def square(self, x, name=None):
+        return self._o("square", x, name=name)
+
+    def pow(self, x, p, name=None):
+        return self._o("pow", x, p, name=name)
+
+    def tanh(self, x, name=None):
+        return self._o("tanh", x, name=name)
+
+    def sin(self, x, name=None):
+        return self._o("sin", x, name=name)
+
+    def cos(self, x, name=None):
+        return self._o("cos", x, name=name)
+
+    def erf(self, x, name=None):
+        return self._o("erf", x, name=name)
+
+    def max(self, a, b, name=None):
+        return self._o("maximum", a, b, name=name)
+
+    def min(self, a, b, name=None):
+        return self._o("minimum", a, b, name=name)
+
+    def neg(self, x, name=None):
+        return self._o("neg", x, name=name)
+
+    def clip_by_value(self, x, lo, hi, name=None):
+        return self._o("clip_by_value", x, name=name, clip_min=lo, clip_max=hi)
+
+    def cumsum(self, x, axis=0, name=None):
+        return self._o("cumsum", x, name=name, axis=axis)
+
+    def is_nan(self, x, name=None):
+        return self._o("isnan", x, name=name)
+
+    def argmax(self, x, dim=None, name=None):
+        return self._o("argmax", x, name=name, dims=dim)
+
+    def mean(self, x, *dims, name=None):
+        return self._o("reduce_mean", x, name=name, dims=list(dims) or None)
+
+    def sum(self, x, *dims, name=None):
+        return self._o("reduce_sum", x, name=name, dims=list(dims) or None)
+
+
+class SDNN(_NS):
+    def relu(self, x, name=None):
+        return self._o("relu", x, name=name)
+
+    def relu6(self, x, name=None):
+        return self._o("relu6", x, name=name)
+
+    def gelu(self, x, name=None):
+        return self._o("gelu", x, name=name)
+
+    def elu(self, x, name=None):
+        return self._o("elu", x, name=name)
+
+    def selu(self, x, name=None):
+        return self._o("selu", x, name=name)
+
+    def swish(self, x, name=None):
+        return self._o("swish", x, name=name)
+
+    def sigmoid(self, x, name=None):
+        return self._o("sigmoid", x, name=name)
+
+    def softplus(self, x, name=None):
+        return self._o("softplus", x, name=name)
+
+    def softmax(self, x, axis=-1, name=None):
+        return self._o("softmax", x, name=name, axis=axis)
+
+    def log_softmax(self, x, axis=-1, name=None):
+        return self._o("log_softmax", x, name=name, axis=axis)
+
+    def leaky_relu(self, x, alpha=0.01, name=None):
+        return self._o("leaky_relu", x, name=name, alpha=alpha)
+
+    def linear(self, x, w, b=None, name=None):
+        args = (x, w) if b is None else (x, w, b)
+        return self._o("linear", *args, name=name)
+
+    def layer_norm(self, x, gain, bias=None, name=None):
+        args = (x, gain) if bias is None else (x, gain, bias)
+        return self._o("layer_norm", *args, name=name)
+
+    def batch_norm(self, x, mean, var, gamma, beta, eps=1e-5, axis=1, name=None):
+        return self._o("batch_norm", x, mean, var, gamma, beta, name=name, eps=eps, axis=axis)
+
+    def dropout(self, x, rng, keep_prob=0.5, name=None):
+        return self._o("dropout", x, rng, name=name, keep_prob=keep_prob)
+
+    def embedding_lookup(self, table, ids, name=None):
+        return self._o("embedding_lookup", table, ids, name=name)
+
+    def dot_product_attention(self, q, k, v, mask=None, name=None):
+        args = (q, k, v) if mask is None else (q, k, v, mask)
+        return self._o("dot_product_attention", *args, name=name)
+
+    def multi_head_dot_product_attention(self, q, k, v, wq, wk, wv, wo, n_heads, name=None):
+        return self._o("multi_head_dot_product_attention", q, k, v, wq, wk, wv, wo,
+                       name=name, n_heads=n_heads)
+
+
+class SDCNN(_NS):
+    def conv2d(self, x, w, b=None, stride=(1, 1), padding="SAME", dilation=(1, 1), name=None):
+        args = (x, w) if b is None else (x, w, b)
+        return self._o("conv2d", *args, name=name, stride=tuple(stride),
+                       padding=padding, dilation=tuple(dilation))
+
+    def max_pooling2d(self, x, kernel=(2, 2), stride=(2, 2), padding="VALID", name=None):
+        return self._o("max_pool2d", x, name=name, kernel=tuple(kernel),
+                       stride=tuple(stride), padding=padding)
+
+    def avg_pooling2d(self, x, kernel=(2, 2), stride=(2, 2), padding="VALID", name=None):
+        return self._o("avg_pool2d", x, name=name, kernel=tuple(kernel),
+                       stride=tuple(stride), padding=padding)
+
+
+class SDRNN(_NS):
+    def lstm_layer(self, x_tnd, h0, c0, wx, wh, b, name=None):
+        return self._o("lstm_layer", x_tnd, h0, c0, wx, wh, b, name=name, n_outputs=3)
+
+    def gru(self, x_tnd, h0, wx, wh, b, name=None):
+        return self._o("gru", x_tnd, h0, wx, wh, b, name=name, n_outputs=2)
+
+
+class SDLoss(_NS):
+    def softmax_cross_entropy(self, labels, logits, weights=None, name=None):
+        args = (labels, logits) if weights is None else (labels, logits, weights)
+        return self._o("softmax_cross_entropy", *args, name=name)
+
+    def sparse_softmax_cross_entropy(self, labels, logits, name=None):
+        return self._o("sparse_softmax_cross_entropy", labels, logits, name=name)
+
+    def sigmoid_cross_entropy(self, labels, logits, name=None):
+        return self._o("sigmoid_cross_entropy", labels, logits, name=name)
+
+    def mean_squared_error(self, labels, preds, name=None):
+        return self._o("mean_squared_error", labels, preds, name=name)
+
+    def absolute_difference(self, labels, preds, name=None):
+        return self._o("mean_absolute_error", labels, preds, name=name)
+
+    def huber_loss(self, labels, preds, delta=1.0, name=None):
+        return self._o("huber_loss", labels, preds, name=name, delta=delta)
+
+    def log_loss(self, labels, preds, name=None):
+        return self._o("log_loss", labels, preds, name=name)
+
+
+class SDLinalg(_NS):
+    def mmul(self, a, b, transpose_a=False, transpose_b=False, name=None):
+        return self._o("matmul", a, b, name=name, transpose_a=transpose_a,
+                       transpose_b=transpose_b)
+
+    def tensormmul(self, a, b, axes_a, axes_b, name=None):
+        return self._o("tensormmul", a, b, name=name, axes_a=list(axes_a), axes_b=list(axes_b))
+
+    def cholesky(self, x, name=None):
+        return self._o("cholesky", x, name=name)
+
+    def inverse(self, x, name=None):
+        return self._o("matrix_inverse", x, name=name)
+
+    def solve(self, a, b, name=None):
+        return self._o("solve", a, b, name=name)
