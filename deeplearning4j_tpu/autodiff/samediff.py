@@ -18,6 +18,7 @@ from __future__ import annotations
 import io
 import json
 import zipfile
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -150,6 +151,10 @@ class SameDiff:
         self._name_counter = 0
         self._fn_cache: Dict[Any, Callable] = {}
         self.listeners: List[Any] = []
+        self.seed = 0
+        self.iteration_count = 0  # persisted: Adam bias-correction / LR
+        # schedules continue across save/load (DL4J TrainingConfig keeps
+        # iterationCount for the same reason)
 
     # --------------------------------------------------------------- create
 
@@ -166,18 +171,29 @@ class SameDiff:
         return name
 
     def var(self, name: str, arr_or_shape=None, *, shape=None, weight_init: str = "xavier",
-            dtype=jnp.float32) -> SDVariable:
-        """Trainable variable (sd.var): from array, or shape + initializer."""
-        if hasattr(arr_or_shape, "shape") or isinstance(arr_or_shape, (list, float, int)) and not isinstance(arr_or_shape, (tuple,)):
-            arr = jnp.asarray(np.asarray(arr_or_shape, dtype=np.float32))
-        elif isinstance(arr_or_shape, tuple) or shape is not None:
+            dtype=None) -> SDVariable:
+        """Trainable variable (sd.var): from an array/list (data), or a
+        TUPLE / shape= kwarg (shape + initializer). Lists are always data
+        (numpy convention); pass a tuple or shape= for dimensions."""
+        if name in self.vars:
+            raise ValueError(f"variable '{name}' already exists")
+        if isinstance(arr_or_shape, tuple) or shape is not None:
             shp = tuple(shape if shape is not None else arr_or_shape)
-            key = jax.random.key(abs(hash(name)) % (2 ** 31))
+            dt = dtype or jnp.float32
+            # stable per-name seeding (zlib.crc32, not salted str hash) xor
+            # the graph seed so runs reproduce
+            key = jax.random.key((zlib.crc32(name.encode()) ^ self.seed) % (2 ** 31))
             if weight_init == "zeros" or len(shp) < 2:
-                arr = jnp.zeros(shp, dtype)
+                arr = jnp.zeros(shp, dt)
             else:
                 fan_in = int(np.prod(shp[:-1]))
-                arr = jax.random.normal(key, shp, dtype) * jnp.sqrt(2.0 / (fan_in + shp[-1]))
+                arr = jax.random.normal(key, shp, dt) * jnp.sqrt(2.0 / (fan_in + shp[-1]))
+        elif arr_or_shape is not None:
+            arr = jnp.asarray(np.asarray(arr_or_shape))
+            if dtype is not None:
+                arr = arr.astype(dtype)
+            elif arr.dtype == jnp.float64:
+                arr = arr.astype(jnp.float32)
         else:
             raise ValueError("var() needs an array or a shape")
         v = SDVariable(self, name, VariableType.VARIABLE, tuple(arr.shape), arr.dtype)
@@ -186,6 +202,8 @@ class SameDiff:
         return v
 
     def constant(self, name: str, arr) -> SDVariable:
+        if name in self.vars:
+            raise ValueError(f"variable '{name}' already exists")
         arr = jnp.asarray(np.asarray(arr))
         v = SDVariable(self, name, VariableType.CONSTANT, tuple(arr.shape), arr.dtype)
         self.vars[name] = v
@@ -227,6 +245,8 @@ class SameDiff:
     def _add_op(self, op_name: str, inputs: List[SDVariable], *, name: Optional[str] = None,
                 kwargs: Optional[Dict[str, Any]] = None, n_outputs: int = 1):
         get_op(op_name)  # validate now
+        if name is not None and name in self.vars:
+            raise ValueError(f"variable '{name}' already exists")
         out_names = ([name] if name and n_outputs == 1
                      else [self._fresh(name or op_name) for _ in range(n_outputs)])
         node = OpNode(op_name, [v.name for v in inputs], out_names,
@@ -401,9 +421,12 @@ class SameDiff:
         if not self.updater_state:
             self.updater_state = cfg.updater.init(
                 {n: self.arrays[n] for n in self._trainable()})
-        step, trainable = self._train_step()
+        key = ("__train__", tuple(self.loss_names))
+        if key not in self._fn_cache:
+            self._fn_cache[key] = self._train_step()
+        step, trainable = self._fn_cache[key]
         history = History()
-        it_count = 0
+        it_count = self.iteration_count
         for _ in range(epochs):
             losses = []
             for ds in iterator:
@@ -417,6 +440,7 @@ class SameDiff:
                 self.arrays.update(new_params)
                 losses.append(loss)
                 it_count += 1
+                self.iteration_count = it_count
                 for lst in self.listeners:
                     if hasattr(lst, "iteration_done"):
                         lst.iteration_done(self, it_count, 0)
@@ -439,6 +463,8 @@ class SameDiff:
                     for n in self.ops],
             "loss": self.loss_names,
             "training_config": self.training_config.to_json() if self.training_config else None,
+            "iteration_count": self.iteration_count,
+            "seed": self.seed,
         }
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
             z.writestr("graph.json", json.dumps(graph))
@@ -468,6 +494,8 @@ class SameDiff:
             sd.ops.append(OpNode(n["op"], n["inputs"], n["outputs"], n["kwargs"], n["n_outputs"]))
         sd.arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
         sd.loss_names = graph.get("loss", [])
+        sd.iteration_count = graph.get("iteration_count", 0)
+        sd.seed = graph.get("seed", 0)
         if graph.get("training_config"):
             sd.training_config = TrainingConfig.from_json(graph["training_config"])
         return sd

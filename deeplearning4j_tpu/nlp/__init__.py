@@ -1,0 +1,32 @@
+"""NLP: tokenization, vocab, Word2Vec, BERT input pipeline.
+
+Reference: ``deeplearning4j-nlp`` (SURVEY §2.5): SequenceVectors/Word2Vec
+(P1), VocabCache/serialization (P2), tokenizers (P3), BERT WordPiece +
+BertIterator (P4).
+"""
+
+from .bert_iterator import BertIterator, BertMaskedLMMasker
+from .tokenization import (
+    BertWordPieceTokenizer,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Tokenizer,
+)
+from .vocab import Huffman, VocabCache, VocabConstructor, VocabWord
+from .word2vec import Word2Vec
+from .word_vectors import WordVectorSerializer
+
+__all__ = [
+    "Tokenizer",
+    "DefaultTokenizerFactory",
+    "CommonPreprocessor",
+    "BertWordPieceTokenizer",
+    "VocabWord",
+    "VocabCache",
+    "VocabConstructor",
+    "Huffman",
+    "Word2Vec",
+    "WordVectorSerializer",
+    "BertIterator",
+    "BertMaskedLMMasker",
+]

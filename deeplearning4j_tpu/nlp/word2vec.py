@@ -1,0 +1,269 @@
+"""Word2Vec — skip-gram / CBOW with negative sampling.
+
+Reference: ``org.deeplearning4j.models.word2vec.Word2Vec`` over
+``SequenceVectors`` (SURVEY §2.5 P1, call stack §3.5): vocab build →
+InMemoryLookupTable (syn0 ~ U(-0.5,0.5)/dim, syn1neg zeros, unigram^0.75
+sample table) → per-thread batches → fused native sg_cb kernel doing
+per-(target,context,negatives) dot/sigmoid/axpy row updates.
+
+TPU inversion (SURVEY §7.2 hard part #4, plan A): the scatter workload
+becomes BATCHED dense ops in ONE jitted step — gather rows for a batch of
+(target, context, negatives) triples, sigmoid dots, scatter-add updates on
+donated tables. Negative sampling uses the same unigram^0.75 table,
+pre-sampled host-side per batch (counter-based determinism via seed).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tokenization import DefaultTokenizerFactory
+from .vocab import Huffman, VocabCache, VocabConstructor
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("neg",))
+def _sgns_step(syn0, syn1, targets, contexts, negatives, lr, neg: int):
+    """One batched skip-gram negative-sampling step.
+
+    targets/contexts: [B] int32; negatives: [B, neg] int32.
+    positive pairs: label 1 on (context→syn0 row, target→syn1 row) per the
+    reference convention; negatives: label 0.
+    """
+    w = syn0[contexts]                       # [B, D]
+    pos = syn1[targets]                      # [B, D]
+    negs = syn1[negatives]                   # [B, neg, D]
+
+    # positive: g = (1 - sigmoid(w·pos)) * lr
+    pd = jnp.sum(w * pos, axis=-1)           # [B]
+    gp = (1.0 - jax.nn.sigmoid(pd)) * lr     # [B]
+    # negative: g = (0 - sigmoid(w·neg)) * lr
+    nd = jnp.einsum("bd,bnd->bn", w, negs)   # [B, neg]
+    gn = -jax.nn.sigmoid(nd) * lr            # [B, neg]
+
+    # accumulate input-vector update: gp*pos + sum_n gn*neg_n.
+    # Within-batch duplicate rows are AVERAGED, not summed: the reference's
+    # sequential sg_cb kernel self-limits via sigmoid saturation between
+    # row touches; a batched scatter-SUM applies every duplicate at stale
+    # values and diverges when vocab << batch. Averaging equals the exact
+    # update when duplicates are rare (any realistic vocab).
+    V = syn0.shape[0]
+    dw = gp[:, None] * pos + jnp.einsum("bn,bnd->bd", gn, negs)
+    c0 = jnp.zeros((V,), syn0.dtype).at[contexts].add(1.0)
+    syn0 = syn0.at[contexts].add(dw / c0[contexts][:, None])
+
+    flat_negs = negatives.reshape(-1)
+    c1 = jnp.zeros((V,), syn1.dtype).at[targets].add(1.0).at[flat_negs].add(1.0)
+    syn1 = syn1.at[targets].add(gp[:, None] * w / c1[targets][:, None])
+    syn1 = syn1.at[flat_negs].add(
+        (gn[..., None] * w[:, None, :]).reshape(-1, w.shape[-1])
+        / c1[flat_negs][:, None])
+    return syn0, syn1
+
+
+class Word2Vec:
+    def __init__(self, layer_size: int = 100, window: int = 5, min_word_frequency: int = 1,
+                 negative: int = 5, subsampling: float = 1e-3, learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, epochs: int = 1, batch_size: int = 512,
+                 seed: int = 42, tokenizer_factory=None, cbow: bool = False):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.subsampling = subsampling
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.tok = tokenizer_factory or DefaultTokenizerFactory()
+        self.cbow = cbow
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None
+        self.syn1neg: Optional[np.ndarray] = None
+        self._sample_table: Optional[np.ndarray] = None
+        self._sentences = None
+
+    # ------------------------------------------------------------ builder
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._iter = None
+
+        def layer_size(self, n):
+            self._kw["layer_size"] = n
+            return self
+
+        layerSize = layer_size
+
+        def window_size(self, n):
+            self._kw["window"] = n
+            return self
+
+        windowSize = window_size
+
+        def min_word_frequency(self, n):
+            self._kw["min_word_frequency"] = n
+            return self
+
+        minWordFrequency = min_word_frequency
+
+        def negative_sample(self, n):
+            self._kw["negative"] = int(n)
+            return self
+
+        negativeSample = negative_sample
+
+        def sampling(self, t):
+            self._kw["subsampling"] = t
+            return self
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = lr
+            return self
+
+        learningRate = learning_rate
+
+        def epochs(self, n):
+            self._kw["epochs"] = n
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def batch_size(self, n):
+            self._kw["batch_size"] = n
+            return self
+
+        batchSize = batch_size
+
+        def tokenizer_factory(self, t):
+            self._kw["tokenizer_factory"] = t
+            return self
+
+        tokenizerFactory = tokenizer_factory
+
+        def iterate(self, sentences):
+            self._iter = sentences
+            return self
+
+        def build(self) -> "Word2Vec":
+            w = Word2Vec(**self._kw)
+            w._sentences = self._iter
+            return w
+
+    # ---------------------------------------------------------------- fit
+
+    def fit(self, sentences: Optional[Iterable[str]] = None) -> "Word2Vec":
+        if sentences is None and self._sentences is None:
+            raise ValueError("no corpus: pass sentences to fit() or Builder.iterate()")
+        sentences = list(sentences if sentences is not None else self._sentences)
+        self.vocab = VocabConstructor(self.tok, self.min_word_frequency).build_vocab(sentences)
+        Huffman(self.vocab.vocab_words()).build()
+        V, D = self.vocab.num_words(), self.layer_size
+        rs = np.random.RandomState(self.seed)
+        # InMemoryLookupTable.resetWeights: syn0 ~ U(-0.5,0.5)/dim, syn1 zeros
+        self.syn0 = ((rs.rand(V, D).astype(np.float32) - 0.5) / D)
+        self.syn1neg = np.zeros((V, D), np.float32)
+        self._build_sample_table()
+
+        pairs = self._training_pairs(sentences, rs)
+        total = len(pairs) * self.epochs
+        syn0, syn1 = jnp.asarray(self.syn0), jnp.asarray(self.syn1neg)
+        done = 0
+        for ep in range(self.epochs):
+            rs.shuffle(pairs)
+            arr = np.asarray(pairs, np.int32)
+            if len(arr) % self.batch_size:
+                # pad the tail to the static batch size with resampled pairs
+                # (keeps ONE executable; duplicates are harmless SGD noise)
+                pad = self.batch_size - len(arr) % self.batch_size
+                arr = np.concatenate([arr, arr[rs.randint(0, len(arr), pad)]])
+            for off in range(0, len(arr), self.batch_size):
+                batch = arr[off : off + self.batch_size]
+                # lr linear decay by pairs processed (SequenceVectors semantics)
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - done / max(total, 1)))
+                negs = self._sample_negatives(rs, len(batch))
+                syn0, syn1 = _sgns_step(
+                    syn0, syn1, jnp.asarray(batch[:, 0]), jnp.asarray(batch[:, 1]),
+                    jnp.asarray(negs), jnp.float32(lr), neg=self.negative)
+                done += len(batch)
+        self.syn0 = np.asarray(syn0)
+        self.syn1neg = np.asarray(syn1)
+        return self
+
+    def _build_sample_table(self, size: int = 1 << 20):
+        counts = np.asarray([w.count for w in self.vocab.vocab_words()], np.float64)
+        probs = counts ** 0.75
+        probs /= probs.sum()
+        self._sample_table = np.searchsorted(np.cumsum(probs), np.linspace(0, 1, size, endpoint=False)).astype(np.int32)
+
+    def _sample_negatives(self, rs, batch: int) -> np.ndarray:
+        idx = rs.randint(0, len(self._sample_table), size=(batch, self.negative))
+        return self._sample_table[idx]
+
+    def _training_pairs(self, sentences, rs) -> List:
+        """(target, context) index pairs with window shuffle + frequency
+        subsampling (SkipGram.learnSequence semantics)."""
+        pairs = []
+        total = self.vocab.total_word_count
+        t = self.subsampling
+        for s in sentences:
+            idxs = [self.vocab.index_of(tok) for tok in self.tok.create(s).get_tokens()]
+            idxs = [i for i in idxs if i >= 0]
+            if t > 0:
+                kept = []
+                for i in idxs:
+                    f = self.vocab.word_frequency(self.vocab.word_at_index(i)) / total
+                    keep_p = (np.sqrt(f / t) + 1) * (t / f) if f > t else 1.0
+                    if rs.rand() < keep_p:
+                        kept.append(i)
+                idxs = kept
+            for pos, target in enumerate(idxs):
+                b = rs.randint(1, self.window + 1)  # dynamic window
+                for off in range(-b, b + 1):
+                    if off == 0:
+                        continue
+                    cpos = pos + off
+                    if 0 <= cpos < len(idxs):
+                        pairs.append((target, idxs[cpos]))
+        return pairs
+
+    # ------------------------------------------------------------ queries
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self.syn0[i]
+
+    getWordVectorMatrix = get_word_vector
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return float(np.dot(va, vb) / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.get_word_vector(word)
+        if v is None:
+            return []
+        norms = self.syn0 / (np.linalg.norm(self.syn0, axis=1, keepdims=True) + 1e-12)
+        sims = norms @ (v / (np.linalg.norm(v) + 1e-12))
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w != word:
+                out.append(w)
+            if len(out) >= n:
+                break
+        return out
+
+    wordsNearest = words_nearest

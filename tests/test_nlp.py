@@ -1,0 +1,118 @@
+"""NLP tests (SURVEY §2.5): tokenizers, vocab/Huffman, Word2Vec SGNS on the
+batched-TPU path, WordPiece + BertIterator."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BertIterator,
+    BertMaskedLMMasker,
+    BertWordPieceTokenizer,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Huffman,
+    VocabConstructor,
+    Word2Vec,
+    WordVectorSerializer,
+)
+
+
+def test_default_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 foo.bar").get_tokens()
+    assert toks == ["hello", "world", "foobar"]
+
+
+def test_vocab_constructor_and_huffman():
+    sents = ["a a a a b b c", "a b c d"]
+    vocab = VocabConstructor(min_word_frequency=2).build_vocab(sents)
+    assert vocab.num_words() == 3  # d dropped (freq 1)
+    assert vocab.word_at_index(0) == "a"  # most frequent first
+    words = vocab.vocab_words()
+    Huffman(words).build()
+    # Huffman: most frequent word gets the shortest code
+    lens = {w.word: len(w.codes) for w in words}
+    assert lens["a"] <= lens["b"] <= lens["c"]
+    assert all(len(w.codes) == len(w.points) for w in words)
+
+
+def _cluster_corpus(n=300, seed=1):
+    """Two co-occurrence clusters: {cat,dog,pet} and {car,bus,road}."""
+    rs = np.random.RandomState(seed)
+    a, b = ["cat", "dog", "pet"], ["car", "bus", "road"]
+    sents = []
+    for _ in range(n):
+        grp = a if rs.rand() < 0.5 else b
+        sents.append(" ".join(rs.choice(grp, size=6)))
+    return sents
+
+
+def test_word2vec_sgns_clusters():
+    w2v = (Word2Vec.Builder()
+           .layer_size(24).window_size(3).min_word_frequency(1)
+           .negative_sample(4).learning_rate(0.1).epochs(10)
+           .batch_size(256).seed(7).sampling(0.0)  # 6-word vocab: every word
+           # is "frequent"; default subsampling would discard ~90% of tokens
+           .iterate(_cluster_corpus())
+           .build())
+    w2v.fit()
+    # in-cluster similarity must beat cross-cluster
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "car")
+    assert w2v.similarity("bus", "road") > w2v.similarity("bus", "pet")
+    near = w2v.words_nearest("cat", 2)
+    assert set(near) <= {"dog", "pet"}
+
+
+def test_word_vector_serializer_roundtrip(tmp_path):
+    w2v = Word2Vec(layer_size=8, epochs=1, batch_size=64, seed=3)
+    w2v.fit(_cluster_corpus(50))
+    p = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    w2 = WordVectorSerializer.read_word_vectors(p)
+    v1, v2 = w2v.get_word_vector("cat"), w2.get_word_vector("cat")
+    np.testing.assert_allclose(v1, v2, atol=1e-5)
+
+
+def _wp_vocab():
+    words = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over", "dog", "."]
+    return {w: i for i, w in enumerate(words)}
+
+
+def test_wordpiece_tokenizer():
+    tok = BertWordPieceTokenizer(_wp_vocab())
+    assert tok.tokenize("The quick fox jumped") == ["the", "quick", "fox", "jump", "##ed"]
+    assert tok.tokenize("zebra") == ["[UNK]"]
+    ids = tok.convert_tokens_to_ids(["the", "##s"])
+    assert ids == [_wp_vocab()["the"], _wp_vocab()["##s"]]
+
+
+def test_bert_iterator_masked_lm():
+    tok = BertWordPieceTokenizer(_wp_vocab())
+    sents = ["the quick brown fox", "the dog jumps over the fox ."] * 4
+    it = BertIterator(tokenizer=tok, sentences=sents, max_length=16, batch_size=4,
+                      task="UNSUPERVISED",
+                      masker=BertMaskedLMMasker(mask_token_id=_wp_vocab()["[MASK]"],
+                                                vocab_size=len(_wp_vocab())))
+    mds = next(iter(it))
+    ids, segs = mds.features
+    assert ids.shape == (4, 16) and segs.shape == (4, 16)
+    labels = mds.labels[0]
+    lm_mask = mds.labels_masks[0]
+    assert lm_mask.sum() >= 4  # ≥1 masked position per sentence
+    # where lm_mask is set, labels hold the ORIGINAL token (ids may differ)
+    masked_pos = np.nonzero(lm_mask)
+    assert labels.shape == ids.shape
+    # all batches drain
+    count = sum(1 for _ in it)
+    assert count == 2
+
+
+def test_bert_iterator_classification():
+    tok = BertWordPieceTokenizer(_wp_vocab())
+    sents = ["the fox", "the dog", "quick fox", "dog ."]
+    it = BertIterator(tokenizer=tok, sentences=sents, labels=[0, 1, 0, 1],
+                      max_length=8, batch_size=2, task="SEQ_CLASSIFICATION", n_classes=2)
+    mds = next(iter(it))
+    assert mds.labels[0].shape == (2, 2)
+    np.testing.assert_allclose(mds.labels[0], [[1, 0], [0, 1]])
