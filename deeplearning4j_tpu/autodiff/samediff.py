@@ -192,7 +192,9 @@ class SameDiff:
             arr = jnp.asarray(np.asarray(arr_or_shape))
             if dtype is not None:
                 arr = arr.astype(dtype)
-            elif arr.dtype == jnp.float64:
+            elif not jnp.issubdtype(arr.dtype, jnp.floating) or arr.dtype == jnp.float64:
+                # trainable variables must be float (jax.grad); int/f64 data
+                # coerces to float32 unless an explicit dtype was given
                 arr = arr.astype(jnp.float32)
         else:
             raise ValueError("var() needs an array or a shape")
@@ -365,6 +367,9 @@ class SameDiff:
 
     def set_training_config(self, cfg: "TrainingConfig"):
         self.training_config = cfg
+        # the compiled train step closes over the config — invalidate it
+        self._fn_cache = {k: v for k, v in self._fn_cache.items()
+                          if not (isinstance(k, tuple) and k and k[0] == "__train__")}
 
     setTrainingConfig = set_training_config
 

@@ -1,4 +1,5 @@
 from .dataset import DataSet, MultiDataSet
+from .datasets import IrisDataSetIterator, MnistDataSetIterator
 from .iterators import (
     DataSetIterator,
     ListDataSetIterator,
@@ -6,6 +7,20 @@ from .iterators import (
     AsyncDataSetIterator,
     MultiDataSetIterator,
 )
+from .normalizers import (
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+from .record_reader_iterator import RecordReaderDataSetIterator
+from .records import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    FileSplit,
+    LineRecordReader,
+    RecordReader,
+)
+from .transform import Schema, TransformProcess
 
 __all__ = [
     "DataSet",
@@ -15,4 +30,17 @@ __all__ = [
     "ArrayDataSetIterator",
     "AsyncDataSetIterator",
     "MultiDataSetIterator",
+    "MnistDataSetIterator",
+    "IrisDataSetIterator",
+    "NormalizerStandardize",
+    "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler",
+    "RecordReader",
+    "CSVRecordReader",
+    "LineRecordReader",
+    "CollectionRecordReader",
+    "FileSplit",
+    "RecordReaderDataSetIterator",
+    "Schema",
+    "TransformProcess",
 ]

@@ -1,0 +1,166 @@
+"""Built-in datasets: MNIST (IDX parsing + synthetic fallback), Iris.
+
+Reference: ``deeplearning4j-datasets`` (SURVEY §2.4 C12):
+``MnistDataSetIterator`` / ``MnistDataFetcher`` (binary IDX parse + fetch),
+``IrisDataSetIterator``. This environment is zero-egress, so the fetch step
+becomes: read IDX files from a local dir if present (``TDL_DATA_DIR`` or
+``~/.deeplearning4j_tpu/mnist``), else generate a DETERMINISTIC synthetic
+digit-like dataset (class-template strokes + noise) so the LeNet baseline
+config still trains and evaluates meaningfully. Divergence documented here.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .dataset import DataSet
+from .iterators import DataSetIterator
+
+
+def _read_idx(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = tuple(struct.unpack(">I", f.read(4))[0] for _ in range(ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(shape)
+
+
+def _find_mnist_dir() -> Optional[str]:
+    cands = [os.environ.get("TDL_DATA_DIR"),
+             os.path.expanduser("~/.deeplearning4j_tpu/mnist"),
+             os.path.expanduser("~/.cache/mnist")]
+    for d in cands:
+        if d and os.path.isdir(d):
+            for name in ("train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"):
+                if os.path.exists(os.path.join(d, name)):
+                    return d
+    return None
+
+
+def _synthetic_mnist(n: int, seed: int, train: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic digit-like 28x28 data: each class = a fixed random
+    low-frequency template; samples = template + jitter + noise. Linearly
+    separable enough that LeNet reaches high accuracy, hard enough that an
+    untrained net doesn't."""
+    rs = np.random.RandomState(1234)  # templates fixed across train/test
+    templates = rs.rand(10, 7, 7).astype(np.float32)
+    rs2 = np.random.RandomState(seed + (0 if train else 10_000))
+    labels = rs2.randint(0, 10, n)
+    imgs = np.empty((n, 28, 28), np.float32)
+    for i, c in enumerate(labels):
+        t = np.kron(templates[c], np.ones((4, 4), np.float32))  # 28x28
+        shift = rs2.randint(-2, 3, 2)
+        t = np.roll(t, tuple(shift), axis=(0, 1))
+        imgs[i] = np.clip(t + 0.15 * rs2.randn(28, 28), 0, 1)
+    return (imgs * 255).astype(np.uint8), labels
+
+
+class MnistDataSetIterator(DataSetIterator):
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None, binarize: bool = False):
+        self.batch_size = batch_size
+        d = _find_mnist_dir()
+        if d is not None:
+            prefix = "train" if train else "t10k"
+            def p(stem):
+                for suff in ("", ".gz"):
+                    path = os.path.join(d, stem + suff)
+                    if os.path.exists(path):
+                        return path
+                raise FileNotFoundError(stem)
+            imgs = _read_idx(p(f"{prefix}-images-idx3-ubyte"))
+            labels = _read_idx(p(f"{prefix}-labels-idx1-ubyte"))
+            self.synthetic = False
+        else:
+            n = num_examples or (10_000 if train else 2_000)
+            imgs, labels = _synthetic_mnist(n, seed, train)
+            self.synthetic = True
+        if num_examples:
+            imgs, labels = imgs[:num_examples], labels[:num_examples]
+        x = imgs.astype(np.float32) / 255.0
+        if binarize:
+            x = (x > 0.5).astype(np.float32)
+        self._x = x.reshape(-1, 1, 28, 28)
+        self._y = np.eye(10, dtype=np.float32)[labels]
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._x)
+
+    def next(self) -> DataSet:
+        b = slice(self._pos, self._pos + self.batch_size)
+        self._pos += self.batch_size
+        return DataSet(self._x[b], self._y[b])
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def total_examples(self) -> int:
+        return len(self._x)
+
+
+_IRIS_DATA = None
+
+
+def _iris_arrays():
+    """Fisher's Iris (public domain, 150 rows) — generated deterministically
+    from the published per-class statistics is NOT the real data, so instead
+    ship the classic dataset inline (petal/sepal measurements)."""
+    global _IRIS_DATA
+    if _IRIS_DATA is None:
+        # 50 rows per class: (sl, sw, pl, pw)
+        raw = """5.1,3.5,1.4,0.2;4.9,3.0,1.4,0.2;4.7,3.2,1.3,0.2;4.6,3.1,1.5,0.2;5.0,3.6,1.4,0.2;5.4,3.9,1.7,0.4;4.6,3.4,1.4,0.3;5.0,3.4,1.5,0.2;4.4,2.9,1.4,0.2;4.9,3.1,1.5,0.1;5.4,3.7,1.5,0.2;4.8,3.4,1.6,0.2;4.8,3.0,1.4,0.1;4.3,3.0,1.1,0.1;5.8,4.0,1.2,0.2;5.7,4.4,1.5,0.4;5.4,3.9,1.3,0.4;5.1,3.5,1.4,0.3;5.7,3.8,1.7,0.3;5.1,3.8,1.5,0.3;5.4,3.4,1.7,0.2;5.1,3.7,1.5,0.4;4.6,3.6,1.0,0.2;5.1,3.3,1.7,0.5;4.8,3.4,1.9,0.2;5.0,3.0,1.6,0.2;5.0,3.4,1.6,0.4;5.2,3.5,1.5,0.2;5.2,3.4,1.4,0.2;4.7,3.2,1.6,0.2;4.8,3.1,1.6,0.2;5.4,3.4,1.5,0.4;5.2,4.1,1.5,0.1;5.5,4.2,1.4,0.2;4.9,3.1,1.5,0.2;5.0,3.2,1.2,0.2;5.5,3.5,1.3,0.2;4.9,3.6,1.4,0.1;4.4,3.0,1.3,0.2;5.1,3.4,1.5,0.2;5.0,3.5,1.3,0.3;4.5,2.3,1.3,0.3;4.4,3.2,1.3,0.2;5.0,3.5,1.6,0.6;5.1,3.8,1.9,0.4;4.8,3.0,1.4,0.3;5.1,3.8,1.6,0.2;4.6,3.2,1.4,0.2;5.3,3.7,1.5,0.2;5.0,3.3,1.4,0.2;7.0,3.2,4.7,1.4;6.4,3.2,4.5,1.5;6.9,3.1,4.9,1.5;5.5,2.3,4.0,1.3;6.5,2.8,4.6,1.5;5.7,2.8,4.5,1.3;6.3,3.3,4.7,1.6;4.9,2.4,3.3,1.0;6.6,2.9,4.6,1.3;5.2,2.7,3.9,1.4;5.0,2.0,3.5,1.0;5.9,3.0,4.2,1.5;6.0,2.2,4.0,1.0;6.1,2.9,4.7,1.4;5.6,2.9,3.6,1.3;6.7,3.1,4.4,1.4;5.6,3.0,4.5,1.5;5.8,2.7,4.1,1.0;6.2,2.2,4.5,1.5;5.6,2.5,3.9,1.1;5.9,3.2,4.8,1.8;6.1,2.8,4.0,1.3;6.3,2.5,4.9,1.5;6.1,2.8,4.7,1.2;6.4,2.9,4.3,1.3;6.6,3.0,4.4,1.4;6.8,2.8,4.8,1.4;6.7,3.0,5.0,1.7;6.0,2.9,4.5,1.5;5.7,2.6,3.5,1.0;5.5,2.4,3.8,1.1;5.5,2.4,3.7,1.0;5.8,2.7,3.9,1.2;6.0,2.7,5.1,1.6;5.4,3.0,4.5,1.5;6.0,3.4,4.5,1.6;6.7,3.1,4.7,1.5;6.3,2.3,4.4,1.3;5.6,3.0,4.1,1.3;5.5,2.5,4.0,1.3;5.5,2.6,4.4,1.2;6.1,3.0,4.6,1.4;5.8,2.6,4.0,1.2;5.0,2.3,3.3,1.0;5.6,2.7,4.2,1.3;5.7,3.0,4.2,1.2;5.7,2.9,4.2,1.3;6.2,2.9,4.3,1.3;5.1,2.5,3.0,1.1;5.7,2.8,4.1,1.3;6.3,3.3,6.0,2.5;5.8,2.7,5.1,1.9;7.1,3.0,5.9,2.1;6.3,2.9,5.6,1.8;6.5,3.0,5.8,2.2;7.6,3.0,6.6,2.1;4.9,2.5,4.5,1.7;7.3,2.9,6.3,1.8;6.7,2.5,5.8,1.8;7.2,3.6,6.1,2.5;6.5,3.2,5.1,2.0;6.4,2.7,5.3,1.9;6.8,3.0,5.5,2.1;5.7,2.5,5.0,2.0;5.8,2.8,5.1,2.4;6.4,3.2,5.3,2.3;6.5,3.0,5.5,1.8;7.7,3.8,6.7,2.2;7.7,2.6,6.9,2.3;6.0,2.2,5.0,1.5;6.9,3.2,5.7,2.3;5.6,2.8,4.9,2.0;7.7,2.8,6.7,2.0;6.3,2.7,4.9,1.8;6.7,3.3,5.7,2.1;7.2,3.2,6.0,1.8;6.2,2.8,4.8,1.8;6.1,3.0,4.9,1.8;6.4,2.8,5.6,2.1;7.2,3.0,5.8,1.6;7.4,2.8,6.1,1.9;7.9,3.8,6.4,2.0;6.4,2.8,5.6,2.2;6.3,2.8,5.1,1.5;6.1,2.6,5.6,1.4;7.7,3.0,6.1,2.3;6.3,3.4,5.6,2.4;6.4,3.1,5.5,1.8;6.0,3.0,4.8,1.8;6.9,3.1,5.4,2.1;6.7,3.1,5.6,2.4;6.9,3.1,5.1,2.3;5.8,2.7,5.1,1.9;6.8,3.2,5.9,2.3;6.7,3.3,5.7,2.5;6.7,3.0,5.2,2.3;6.3,2.5,5.0,1.9;6.5,3.0,5.2,2.0;6.2,3.4,5.4,2.3;5.9,3.0,5.1,1.8"""
+        X = np.asarray([[float(v) for v in row.split(",")] for row in raw.split(";")],
+                       np.float32)
+        y = np.repeat(np.arange(3), 50)
+        _IRIS_DATA = (X, np.eye(3, dtype=np.float32)[y])
+    return _IRIS_DATA
+
+
+class IrisDataSetIterator(DataSetIterator):
+    """org.deeplearning4j.datasets.iterator.impl.IrisDataSetIterator."""
+
+    def __init__(self, batch_size: int = 150, num_examples: int = 150, shuffle_seed: Optional[int] = 42):
+        X, Y = _iris_arrays()
+        if shuffle_seed is not None:
+            rs = np.random.RandomState(shuffle_seed)
+            perm = rs.permutation(len(X))
+            X, Y = X[perm], Y[perm]
+        self._x, self._y = X[:num_examples], Y[:num_examples]
+        self.batch_size = batch_size
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._x)
+
+    def next(self) -> DataSet:
+        b = slice(self._pos, self._pos + self.batch_size)
+        self._pos += self.batch_size
+        return DataSet(self._x[b], self._y[b])
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()

@@ -1,0 +1,173 @@
+"""Record readers & input splits.
+
+Reference: datavec-api (SURVEY §2.3 D1): ``RecordReader`` SPI over
+``InputSplit`` sources (``FileSplit``), readers ``CSVRecordReader``,
+``LineRecordReader``, ``CollectionRecordReader``; values are ``Writable``s
+(here: plain python str/float — the Writable hierarchy adds nothing in
+Python, documented merge).
+"""
+
+from __future__ import annotations
+
+import csv
+import glob
+import os
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+
+class InputSplit:
+    def locations(self) -> List[str]:
+        raise NotImplementedError
+
+
+class FileSplit(InputSplit):
+    """org.datavec.api.split.FileSplit: root dir or file (+ extension filter,
+    recursive)."""
+
+    def __init__(self, path: str, allowed_extensions: Optional[Sequence[str]] = None,
+                 recursive: bool = True):
+        self.path = path
+        self.exts = tuple(allowed_extensions) if allowed_extensions else None
+        self.recursive = recursive
+
+    def locations(self) -> List[str]:
+        if os.path.isfile(self.path):
+            return [self.path]
+        pattern = "**/*" if self.recursive else "*"
+        files = [f for f in glob.glob(os.path.join(self.path, pattern), recursive=self.recursive)
+                 if os.path.isfile(f)]
+        if self.exts:
+            files = [f for f in files if f.endswith(self.exts)]
+        return sorted(files)
+
+
+class ListStringSplit(InputSplit):
+    def __init__(self, data: List[List[str]]):
+        self.data = data
+
+    def locations(self):
+        return []
+
+
+class RecordReader:
+    """org.datavec.api.records.reader.RecordReader."""
+
+    def initialize(self, split: InputSplit) -> "RecordReader":
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> List:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[List]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+    hasNext = has_next
+
+
+class CSVRecordReader(RecordReader):
+    """org.datavec.api.records.reader.impl.csv.CSVRecordReader: skip lines,
+    delimiter, quote handling via csv module."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        self.skip = skip_num_lines
+        self.delimiter = delimiter
+        self._rows: List[List[str]] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit) -> "CSVRecordReader":
+        self._rows = []
+        for path in split.locations():
+            with open(path, newline="", encoding="utf-8") as f:
+                rows = list(csv.reader(f, delimiter=self.delimiter))
+            self._rows.extend(rows[self.skip:])
+        self._pos = 0
+        return self
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._rows)
+
+    def next(self) -> List[str]:
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def reset(self):
+        self._pos = 0
+
+
+class LineRecordReader(RecordReader):
+    """impl.LineRecordReader: one record per line."""
+
+    def __init__(self):
+        self._lines: List[str] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit) -> "LineRecordReader":
+        self._lines = []
+        for path in split.locations():
+            with open(path, encoding="utf-8") as f:
+                self._lines.extend(line.rstrip("\n") for line in f)
+        self._pos = 0
+        return self
+
+    def has_next(self):
+        return self._pos < len(self._lines)
+
+    def next(self) -> List[str]:
+        line = self._lines[self._pos]
+        self._pos += 1
+        return [line]
+
+    def reset(self):
+        self._pos = 0
+
+
+class CollectionRecordReader(RecordReader):
+    """impl.collection.CollectionRecordReader: records from memory."""
+
+    def __init__(self, records: Iterable[List]):
+        self._records = [list(r) for r in records]
+        self._pos = 0
+
+    def initialize(self, split: Optional[InputSplit] = None):
+        self._pos = 0
+        return self
+
+    def has_next(self):
+        return self._pos < len(self._records)
+
+    def next(self):
+        r = self._records[self._pos]
+        self._pos += 1
+        return r
+
+    def reset(self):
+        self._pos = 0
+
+
+def load_csv_f32(path: str, delimiter: str = ",", skip_rows: int = 0):
+    """Fast numeric-CSV load → float32 [rows, cols]: native tnd parser when
+    available (releases the GIL; datavec D1 hot-path analog), numpy fallback.
+    Returns None if the file is not purely numeric."""
+    import numpy as np
+
+    from .. import native as _native
+
+    with open(path, "rb") as f:
+        data = f.read()
+    arr = _native.csv_parse(data, delimiter, skip_rows) if _native.available() else None
+    if arr is not None:
+        return arr
+    try:
+        return np.loadtxt(path, delimiter=delimiter, skiprows=skip_rows,
+                          dtype=np.float32, ndmin=2)
+    except ValueError:
+        return None

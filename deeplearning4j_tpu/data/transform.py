@@ -1,0 +1,362 @@
+"""Schema + TransformProcess — dataframe-style typed transforms.
+
+Reference: datavec ``org.datavec.api.transform.TransformProcess`` over a
+``schema.Schema`` (SURVEY §2.3 D2): categorical/one-hot conversion,
+normalization ops, string/math column ops, filters, remove/rename — all
+JSON-serializable (the serialization invariant gives versioned pipelines).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+class ColumnType:
+    STRING = "String"
+    INTEGER = "Integer"
+    DOUBLE = "Double"
+    CATEGORICAL = "Categorical"
+    LONG = "Long"
+
+
+class Schema:
+    """org.datavec.api.transform.schema.Schema (+Builder)."""
+
+    def __init__(self, columns: Optional[List[Dict[str, Any]]] = None):
+        self.columns = columns or []
+
+    class Builder:
+        def __init__(self):
+            self._cols: List[Dict[str, Any]] = []
+
+        def add_column_string(self, name: str):
+            self._cols.append({"name": name, "type": ColumnType.STRING})
+            return self
+
+        addColumnString = add_column_string
+
+        def add_column_integer(self, name: str):
+            self._cols.append({"name": name, "type": ColumnType.INTEGER})
+            return self
+
+        addColumnInteger = add_column_integer
+
+        def add_column_double(self, name: str):
+            self._cols.append({"name": name, "type": ColumnType.DOUBLE})
+            return self
+
+        addColumnDouble = add_column_double
+
+        def add_column_categorical(self, name: str, *states: str):
+            self._cols.append({"name": name, "type": ColumnType.CATEGORICAL,
+                               "states": list(states)})
+            return self
+
+        addColumnCategorical = add_column_categorical
+
+        def build(self) -> "Schema":
+            return Schema(list(self._cols))
+
+    def names(self) -> List[str]:
+        return [c["name"] for c in self.columns]
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c["name"] == name:
+                return i
+        raise KeyError(name)
+
+    def column(self, name: str) -> Dict[str, Any]:
+        return self.columns[self.index_of(name)]
+
+    def to_json(self) -> str:
+        return json.dumps({"columns": self.columns})
+
+    @staticmethod
+    def from_json(s: str) -> "Schema":
+        return Schema(json.loads(s)["columns"])
+
+
+# ------------------------------------------------------------------- steps
+
+
+_STEP_REGISTRY: Dict[str, Callable] = {}
+
+
+def _step(name):
+    def deco(cls):
+        _STEP_REGISTRY[name] = cls
+        cls.step_name = name
+        return cls
+
+    return deco
+
+
+class _Step:
+    def apply_schema(self, schema: Schema) -> Schema:
+        return schema
+
+    def apply(self, rows: List[List], schema: Schema) -> List[List]:
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        d = dict(self.__dict__)
+        d["@step"] = self.step_name
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "_Step":
+        d = dict(d)
+        cls = _STEP_REGISTRY[d.pop("@step")]
+        obj = cls.__new__(cls)
+        obj.__dict__.update(d)
+        return obj
+
+
+@_step("remove_columns")
+class _RemoveColumns(_Step):
+    def __init__(self, names):
+        self.names = list(names)
+
+    def apply_schema(self, schema):
+        return Schema([c for c in schema.columns if c["name"] not in self.names])
+
+    def apply(self, rows, schema):
+        idxs = [schema.index_of(n) for n in self.names]
+        keep = [i for i in range(len(schema.columns)) if i not in idxs]
+        return [[r[i] for i in keep] for r in rows]
+
+
+@_step("rename_column")
+class _RenameColumn(_Step):
+    def __init__(self, old, new):
+        self.old, self.new = old, new
+
+    def apply_schema(self, schema):
+        cols = [dict(c) for c in schema.columns]
+        cols[schema.index_of(self.old)]["name"] = self.new
+        return Schema(cols)
+
+    def apply(self, rows, schema):
+        return rows
+
+
+@_step("categorical_to_integer")
+class _CatToInt(_Step):
+    def __init__(self, name):
+        self.name = name
+
+    def apply_schema(self, schema):
+        cols = [dict(c) for c in schema.columns]
+        i = schema.index_of(self.name)
+        cols[i] = {"name": self.name, "type": ColumnType.INTEGER,
+                   "states": cols[i].get("states")}
+        return Schema(cols)
+
+    def apply(self, rows, schema):
+        i = schema.index_of(self.name)
+        states = schema.column(self.name).get("states") or []
+        lut = {s: j for j, s in enumerate(states)}
+        out = []
+        for r in rows:
+            r = list(r)
+            r[i] = lut[r[i]]
+            out.append(r)
+        return out
+
+
+@_step("categorical_to_one_hot")
+class _CatToOneHot(_Step):
+    def __init__(self, name):
+        self.name = name
+
+    def apply_schema(self, schema):
+        i = schema.index_of(self.name)
+        states = schema.column(self.name).get("states") or []
+        cols = [dict(c) for c in schema.columns]
+        onehot = [{"name": f"{self.name}[{s}]", "type": ColumnType.INTEGER} for s in states]
+        return Schema(cols[:i] + onehot + cols[i + 1:])
+
+    def apply(self, rows, schema):
+        i = schema.index_of(self.name)
+        states = schema.column(self.name).get("states") or []
+        out = []
+        for r in rows:
+            oh = [1 if r[i] == s else 0 for s in states]
+            out.append(list(r[:i]) + oh + list(r[i + 1:]))
+        return out
+
+
+@_step("double_math_op")
+class _DoubleMathOp(_Step):
+    OPS = {"Add": lambda a, b: a + b, "Subtract": lambda a, b: a - b,
+           "Multiply": lambda a, b: a * b, "Divide": lambda a, b: a / b,
+           "Pow": lambda a, b: a ** b}
+
+    def __init__(self, name, op, scalar):
+        self.name, self.op, self.scalar = name, op, scalar
+
+    def apply(self, rows, schema):
+        i = schema.index_of(self.name)
+        f = self.OPS[self.op]
+        out = []
+        for r in rows:
+            r = list(r)
+            r[i] = f(float(r[i]), self.scalar)
+            out.append(r)
+        return out
+
+
+@_step("string_map")
+class _StringMap(_Step):
+    TRANSFORMS = {"lower": str.lower, "upper": str.upper, "strip": str.strip}
+
+    def __init__(self, name, transform):
+        self.name, self.transform = name, transform
+
+    def apply(self, rows, schema):
+        i = schema.index_of(self.name)
+        f = self.TRANSFORMS[self.transform]
+        out = []
+        for r in rows:
+            r = list(r)
+            r[i] = f(str(r[i]))
+            out.append(r)
+        return out
+
+
+@_step("filter_invalid")
+class _FilterInvalid(_Step):
+    """Drop rows whose numeric columns fail to parse (condition filter)."""
+
+    def __init__(self, names):
+        self.names = list(names)
+
+    def apply(self, rows, schema):
+        idxs = [schema.index_of(n) for n in self.names]
+        out = []
+        for r in rows:
+            try:
+                for i in idxs:
+                    float(r[i])
+                out.append(r)
+            except (TypeError, ValueError):
+                pass
+        return out
+
+
+@_step("convert_to_double")
+class _ConvertDouble(_Step):
+    def __init__(self, names):
+        self.names = list(names)
+
+    def apply_schema(self, schema):
+        cols = [dict(c) for c in schema.columns]
+        for n in self.names:
+            cols[schema.index_of(n)]["type"] = ColumnType.DOUBLE
+        return Schema(cols)
+
+    def apply(self, rows, schema):
+        idxs = [schema.index_of(n) for n in self.names]
+        out = []
+        for r in rows:
+            r = list(r)
+            for i in idxs:
+                r[i] = float(r[i])
+            out.append(r)
+        return out
+
+
+# ----------------------------------------------------------------- process
+
+
+class TransformProcess:
+    """Builder-pattern pipeline over a Schema; executable locally
+    (LocalTransformExecutor parity — D4) and JSON round-trippable."""
+
+    def __init__(self, initial_schema: Schema, steps: Optional[List[_Step]] = None):
+        self.initial_schema = initial_schema
+        self.steps = steps or []
+
+    class Builder:
+        def __init__(self, schema: Schema):
+            self._schema = schema
+            self._steps: List[_Step] = []
+
+        def remove_columns(self, *names):
+            self._steps.append(_RemoveColumns(names))
+            return self
+
+        removeColumns = remove_columns
+
+        def rename_column(self, old, new):
+            self._steps.append(_RenameColumn(old, new))
+            return self
+
+        renameColumn = rename_column
+
+        def categorical_to_integer(self, name):
+            self._steps.append(_CatToInt(name))
+            return self
+
+        categoricalToInteger = categorical_to_integer
+
+        def categorical_to_one_hot(self, name):
+            self._steps.append(_CatToOneHot(name))
+            return self
+
+        categoricalToOneHot = categorical_to_one_hot
+
+        def double_math_op(self, name, op, scalar):
+            self._steps.append(_DoubleMathOp(name, op, scalar))
+            return self
+
+        doubleMathOp = double_math_op
+
+        def string_map_transform(self, name, transform):
+            self._steps.append(_StringMap(name, transform))
+            return self
+
+        def filter_invalid(self, *names):
+            self._steps.append(_FilterInvalid(names))
+            return self
+
+        def convert_to_double(self, *names):
+            self._steps.append(_ConvertDouble(names))
+            return self
+
+        convertToDouble = convert_to_double
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self._schema, list(self._steps))
+
+    def final_schema(self) -> Schema:
+        schema = self.initial_schema
+        for s in self.steps:
+            schema = s.apply_schema(schema)
+        return schema
+
+    getFinalSchema = final_schema
+
+    def execute(self, rows: List[List]) -> List[List]:
+        schema = self.initial_schema
+        for s in self.steps:
+            rows = s.apply(rows, schema)
+            schema = s.apply_schema(schema)
+        return rows
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "initial_schema": json.loads(self.initial_schema.to_json()),
+            "steps": [s.to_json() for s in self.steps],
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "TransformProcess":
+        d = json.loads(s)
+        return TransformProcess(
+            Schema(d["initial_schema"]["columns"]),
+            [_Step.from_json(sd) for sd in d["steps"]],
+        )

@@ -1,17 +1,33 @@
-from . import activations, losses, updaters, weights
+from . import activations, earlystopping, losses, transfer, updaters, weights
 from .conf import NeuralNetConfiguration, MultiLayerConfiguration
+from .earlystopping import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+)
 from .graph_conf import ComputationGraphConfiguration
 from .multilayer import MultiLayerNetwork
 from .graph import ComputationGraph
+from .transfer import FineTuneConfiguration, TransferLearning, TransferLearningHelper
 
 __all__ = [
     "activations",
     "losses",
     "updaters",
     "weights",
+    "earlystopping",
+    "transfer",
     "NeuralNetConfiguration",
     "MultiLayerConfiguration",
     "ComputationGraphConfiguration",
     "MultiLayerNetwork",
     "ComputationGraph",
+    "EarlyStoppingConfiguration",
+    "EarlyStoppingTrainer",
+    "EarlyStoppingGraphTrainer",
+    "EarlyStoppingResult",
+    "TransferLearning",
+    "TransferLearningHelper",
+    "FineTuneConfiguration",
 ]

@@ -98,6 +98,7 @@ class Layer:
     l1: float = 0.0
     l2: float = 0.0
     dropout: float = 0.0  # keep-prob==1-dropout? DL4J: value = retain prob
+    frozen: bool = False  # FrozenLayer (TransferLearning): no param updates
 
     def output_type(self, input_type: InputType) -> InputType:
         return input_type

@@ -20,7 +20,7 @@ from ..eval.evaluation import Evaluation
 from ..ndarray.ndarray import NDArray
 from .conf import BatchNormalization, GlobalPoolingLayer, LastTimeStep, LSTM, GravesLSTM
 from .graph_conf import ComputationGraphConfiguration
-from .multilayer import _grad_normalize
+from .multilayer import _grad_normalize, _mask_frozen
 
 
 class ComputationGraph:
@@ -124,11 +124,15 @@ class ComputationGraph:
         updater = self.conf.updater
         gn, gnt = self.conf.gradient_normalization, self.conf.gradient_normalization_threshold
 
+        frozen = {name for name, node in self.conf.nodes.items()
+                  if node.layer is not None and node.layer.frozen}
+
         def step(params, upd_state, bn_state, iteration, epoch, inputs, labels, lmasks, rng):
             def loss_fn(p):
                 return self._forward(p, bn_state, inputs, training=True, rng=rng, labels=labels, lmasks=lmasks)
 
             (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = _mask_frozen(grads, frozen)
             grads = _grad_normalize(grads, gn, gnt)
             updates, new_upd = updater.apply(grads, upd_state, params, iteration, epoch)
             new_params = jax.tree.map(lambda p, u: p - u, params, updates)
@@ -220,6 +224,16 @@ class ComputationGraph:
         labels = self._coerce_labels([ds.labels] if isinstance(ds, DataSet) else list(ds.labels))
         loss, _ = self._forward(self.params_, self.bn_state, inputs, training=False, rng=None, labels=labels)
         return float(loss)
+
+    def clone(self) -> "ComputationGraph":
+        # deep-copy buffers: the train step donates state, so replicas must
+        # not alias (a donated buffer is deleted under every alias)
+        g = ComputationGraph(self.conf)
+        g.init()
+        g.params_ = jax.tree.map(jnp.copy, self.params_)
+        g.bn_state = jax.tree.map(jnp.copy, self.bn_state)
+        g.updater_state = jax.tree.map(jnp.copy, self.updater_state)
+        return g
 
     def evaluate(self, iterator) -> Evaluation:
         ev = Evaluation()

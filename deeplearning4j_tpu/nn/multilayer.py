@@ -38,6 +38,15 @@ from .conf import (
 )
 
 
+def _mask_frozen(grads, frozen):
+    """FrozenLayer semantics (TransferLearning C10): zero the gradients of
+    frozen layers inside the compiled step."""
+    if not frozen:
+        return grads
+    return {k: (jax.tree.map(jnp.zeros_like, v) if k in frozen else v)
+            for k, v in grads.items()}
+
+
 def _grad_normalize(grads, kind: Optional[str], threshold: float):
     """org.deeplearning4j.nn.conf.GradientNormalization semantics."""
     if kind is None:
@@ -182,10 +191,13 @@ class MultiLayerNetwork:
         updater = self.conf.updater
         gn, gnt = self.conf.gradient_normalization, self.conf.gradient_normalization_threshold
 
+        frozen = {str(i) for i, l in enumerate(self.conf.layers) if l.frozen}
+
         def step(params, upd_state, bn_state, iteration, epoch, x, y, fmask, lmask, rng):
             (loss, (new_bn, _)), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
                 params, bn_state, x, y, fmask, lmask, rng, True
             )
+            grads = _mask_frozen(grads, frozen)
             grads = _grad_normalize(grads, gn, gnt)
             updates, new_upd = updater.apply(grads, upd_state, params, iteration, epoch)
             new_params = jax.tree.map(lambda p, u: p - u, params, updates)
@@ -200,12 +212,14 @@ class MultiLayerNetwork:
             return self._jit_cache["tbptt"]
         updater = self.conf.updater
         gn, gnt = self.conf.gradient_normalization, self.conf.gradient_normalization_threshold
+        frozen = {str(i) for i, l in enumerate(self.conf.layers) if l.frozen}
 
         def step(params, upd_state, bn_state, rnn_states, iteration, epoch, x, y, fmask, lmask, rng):
             def loss_with_states(p):
                 return self._loss_fn(p, bn_state, x, y, fmask, lmask, rng, True, rnn_states)
 
             (loss, (new_bn, new_rnn)), grads = jax.value_and_grad(loss_with_states, has_aux=True)(params)
+            grads = _mask_frozen(grads, frozen)
             grads = _grad_normalize(grads, gn, gnt)
             updates, new_upd = updater.apply(grads, upd_state, params, iteration, epoch)
             new_params = jax.tree.map(lambda p, u: p - u, params, updates)

@@ -18,11 +18,15 @@ from typing import Tuple
 
 import numpy as np
 
+from .. import native as _native
+
 
 def threshold_encode(grad: np.ndarray, threshold: float) -> np.ndarray:
     """Sparse {signed index} encoding: int32 array [n, idx0±, idx1±, ...]
     where sign of entry encodes update direction and magnitude==threshold.
     Mirrors libnd4j's threshold format (header + signed indices)."""
+    if _native.available():
+        return _native.threshold_encode(grad, threshold)
     flat = np.asarray(grad).reshape(-1)
     idx = np.nonzero(np.abs(flat) >= threshold)[0]
     signs = np.sign(flat[idx]).astype(np.int32)
@@ -32,6 +36,8 @@ def threshold_encode(grad: np.ndarray, threshold: float) -> np.ndarray:
 
 
 def threshold_decode(encoded: np.ndarray, threshold: float) -> np.ndarray:
+    if _native.available():
+        return _native.threshold_decode(np.asarray(encoded), threshold)
     size = int(encoded[0])
     out = np.zeros(size, np.float32)
     body = encoded[1:]
@@ -42,6 +48,8 @@ def threshold_decode(encoded: np.ndarray, threshold: float) -> np.ndarray:
 
 def threshold_residual(grad: np.ndarray, threshold: float) -> Tuple[np.ndarray, np.ndarray]:
     """encode + residual (grad - decoded), the accumulator loop of C7."""
+    if _native.available():
+        return _native.threshold_encode_residual(grad, threshold)
     enc = threshold_encode(grad, threshold)
     dec = threshold_decode(enc, threshold).reshape(np.shape(grad))
     return enc, np.asarray(grad, np.float32) - dec

@@ -1,0 +1,70 @@
+"""Misc utilities.
+
+Reference analogs: ``org.deeplearning4j.util.CrashReportingUtil`` (OOM dump
+reports with memory breakdown — SURVEY §2.4 C16), ``NetworkUtils``,
+``org.nd4j.common`` helpers (J19).
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+import traceback
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def model_memory_report(model) -> Dict[str, Any]:
+    """Parameter/state memory breakdown (CrashReportingUtil's report body)."""
+    import jax
+
+    def tree_bytes(tree):
+        return sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                   for x in jax.tree.leaves(tree) if hasattr(x, "shape"))
+
+    report = {"class": type(model).__name__}
+    for attr in ("params_", "updater_state", "bn_state"):
+        if hasattr(model, attr):
+            report[f"{attr}_bytes"] = tree_bytes(getattr(model, attr))
+    report["total_bytes"] = sum(v for k, v in report.items() if k.endswith("_bytes"))
+    return report
+
+
+def write_crash_dump(model, error: BaseException, path: str = "tdl-crash.txt") -> str:
+    """CrashReportingUtil.writeMemoryCrashDump parity: environment + model
+    memory breakdown + traceback to a file for post-mortem."""
+    import jax
+
+    lines = [
+        "deeplearning4j_tpu crash report",
+        f"python: {sys.version.split()[0]}  platform: {platform.platform()}",
+        f"jax: {jax.__version__}  backend: {jax.default_backend()}",
+        f"devices: {[str(d) for d in jax.devices()]}",
+        "",
+        f"error: {type(error).__name__}: {error}",
+        "".join(traceback.format_exception(type(error), error, error.__traceback__)),
+        "",
+        "model memory:",
+    ]
+    try:
+        for k, v in model_memory_report(model).items():
+            lines.append(f"  {k}: {v}")
+    except Exception as e:  # report must never fail the crash path
+        lines.append(f"  (memory report failed: {e})")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
+def set_learning_rate(model, lr: float) -> None:
+    """NetworkUtils.setLearningRate: adjust the updater lr mid-training."""
+    if hasattr(model.conf.updater, "learning_rate"):
+        model.conf.updater.learning_rate = lr
+    model._jit_cache.pop("train", None)
+    model._jit_cache.pop("tbptt", None)
+
+
+def get_learning_rate(model) -> Optional[float]:
+    return getattr(model.conf.updater, "learning_rate", None)

@@ -1,0 +1,155 @@
+/* tnd native host runtime — implementation. See tnd.h for the contract.
+ *
+ * Style notes: plain C++17 + std::thread (the image bakes g++; no OpenMP
+ * dependency needed at this scale). Hot loops are written branch-light so
+ * the compiler vectorizes them (-O3 -march=native at build time).
+ */
+#include "tnd.h"
+
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+int64_t tnd_version() { return 1; }
+
+int64_t tnd_threshold_encode(const float* grad, int64_t n, float threshold,
+                             int64_t* out, int64_t max_out) {
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const float g = grad[i];
+    if (g >= threshold || g <= -threshold) {
+      if (count < max_out) {
+        out[count] = (g > 0.0f) ? (i + 1) : -(i + 1);
+      }
+      ++count;
+    }
+  }
+  return (count <= max_out) ? count : -count;
+}
+
+void tnd_threshold_decode(const int64_t* enc, int64_t count, float threshold,
+                          float* out, int64_t n) {
+  for (int64_t k = 0; k < count; ++k) {
+    const int64_t e = enc[k];
+    const int64_t idx = (e > 0 ? e : -e) - 1;
+    if (idx >= 0 && idx < n) {
+      out[idx] = (e > 0) ? threshold : -threshold;
+    }
+  }
+}
+
+int64_t tnd_threshold_encode_residual(float* grad, int64_t n, float threshold,
+                                      int64_t* out, int64_t max_out) {
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const float g = grad[i];
+    if (g >= threshold) {
+      if (count < max_out) out[count] = i + 1;
+      ++count;
+      grad[i] = g - threshold;
+    } else if (g <= -threshold) {
+      if (count < max_out) out[count] = -(i + 1);
+      ++count;
+      grad[i] = g + threshold;
+    }
+  }
+  return (count <= max_out) ? count : -count;
+}
+
+void tnd_bitmap_encode(const float* grad, int64_t n, float threshold,
+                       uint8_t* packed) {
+  const int64_t bytes = (n + 3) / 4;
+  std::memset(packed, 0, static_cast<size_t>(bytes));
+  for (int64_t i = 0; i < n; ++i) {
+    const float g = grad[i];
+    uint8_t code = 0;
+    if (g >= threshold) code = 1;
+    else if (g <= -threshold) code = 2;
+    packed[i >> 2] |= static_cast<uint8_t>(code << ((i & 3) * 2));
+  }
+}
+
+void tnd_bitmap_decode(const uint8_t* packed, int64_t n, float threshold,
+                       float* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t code = (packed[i >> 2] >> ((i & 3) * 2)) & 3;
+    out[i] = (code == 1) ? threshold : (code == 2) ? -threshold : 0.0f;
+  }
+}
+
+int32_t tnd_csv_parse_f32(const char* data, int64_t len, char delimiter,
+                          int32_t skip_rows, float* out, int64_t max_vals,
+                          int64_t* n_rows, int64_t* n_cols) {
+  int64_t rows = 0, cols = -1, vals = 0, col_in_row = 0;
+  int64_t i = 0;
+  // skip leading rows
+  for (int32_t s = 0; s < skip_rows && i < len; ++s) {
+    while (i < len && data[i] != '\n') ++i;
+    if (i < len) ++i;
+  }
+  bool in_row = false;
+  while (i < len) {
+    // parse one field with strtof (handles +-, exponents, inf/nan)
+    const char* start = data + i;
+    char* end = nullptr;
+    const float v = std::strtof(start, &end);
+    if (end == start) {
+      // empty field or garbage; skip bare newlines, reject real garbage
+      if (data[i] == '\n' || data[i] == '\r') {
+        ++i;
+        continue;
+      }
+      return -1;
+    }
+    if (vals >= max_vals) return -2;
+    out[vals++] = v;
+    ++col_in_row;
+    in_row = true;
+    i = end - data;
+    // consume delimiter or end-of-line
+    while (i < len && data[i] == '\r') ++i;
+    if (i < len && data[i] == delimiter) {
+      ++i;
+    } else if (i >= len || data[i] == '\n') {
+      if (cols < 0) cols = col_in_row;
+      else if (col_in_row != cols) return -3;
+      ++rows;
+      col_in_row = 0;
+      in_row = false;
+      if (i < len) ++i;
+    }
+  }
+  if (in_row) {  // last row without trailing newline
+    if (cols < 0) cols = col_in_row;
+    else if (col_in_row != cols) return -3;
+    ++rows;
+  }
+  *n_rows = rows;
+  *n_cols = (cols < 0) ? 0 : cols;
+  return 0;
+}
+
+void tnd_parallel_copy_f32(const float* src, float* dst, int64_t n,
+                           int32_t n_threads) {
+  if (n_threads <= 1 || n < (1 << 20)) {
+    std::memcpy(dst, src, static_cast<size_t>(n) * sizeof(float));
+    return;
+  }
+  std::vector<std::thread> threads;
+  const int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    const int64_t a = t * chunk;
+    const int64_t b = std::min<int64_t>(n, a + chunk);
+    if (a >= b) break;
+    threads.emplace_back([=] {
+      std::memcpy(dst + a, src + a, static_cast<size_t>(b - a) * sizeof(float));
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+} /* extern "C" */

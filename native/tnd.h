@@ -1,0 +1,56 @@
+/* tnd — native host runtime for deeplearning4j_tpu.
+ *
+ * C ABI in the spirit of libnd4j's NativeOps.h (reference SURVEY §2.1 N13):
+ * a flat extern "C" surface so non-Python frontends stay possible. The TPU
+ * compute path is XLA/PJRT; this library covers the HOST-side hot paths the
+ * reference implements natively:
+ *   - threshold/bitmap gradient codecs (N15: encodeThresholdP1/encodeBitmap)
+ *   - CSV → float32 block parser (datavec D1 CSVRecordReader hot loop)
+ *   - parallel memcpy/stage (N8 Threads::parallel_for analog)
+ */
+#ifndef TND_H
+#define TND_H
+
+#include <cstdint>
+
+extern "C" {
+
+/* library version for ABI sanity checks */
+int64_t tnd_version();
+
+/* Threshold encoding: out[i] = (index+1) * sign for |grad[index]| >= threshold.
+ * Returns number of encoded entries (<= max_out); if more would be produced,
+ * returns -needed so the caller can re-allocate. */
+int64_t tnd_threshold_encode(const float* grad, int64_t n, float threshold,
+                             int64_t* out, int64_t max_out);
+
+/* Decode into a zeroed buffer of length n: out[|e|-1] = sign(e)*threshold. */
+void tnd_threshold_decode(const int64_t* enc, int64_t count, float threshold,
+                          float* out, int64_t n);
+
+/* Residual update: residual = grad - decode(encode(grad)); done in one pass.
+ * Writes residual in place over grad. Returns encoded count (see above). */
+int64_t tnd_threshold_encode_residual(float* grad, int64_t n, float threshold,
+                                      int64_t* out, int64_t max_out);
+
+/* 2-bit bitmap codec: codes packed 4 per byte; 0=|g|<t, 1=+t, 2=-t. */
+void tnd_bitmap_encode(const float* grad, int64_t n, float threshold,
+                       uint8_t* packed /* size >= (n+3)/4 */);
+void tnd_bitmap_decode(const uint8_t* packed, int64_t n, float threshold,
+                       float* out);
+
+/* CSV block parser: parse `len` bytes of delimiter-separated numeric text
+ * into out (row-major float32). Returns 0 on success, -1 on parse error,
+ * -2 if out capacity (max_vals) exceeded, -3 on ragged rows.
+ * n_rows/n_cols receive the parsed shape. Skips `skip_rows` leading rows. */
+int32_t tnd_csv_parse_f32(const char* data, int64_t len, char delimiter,
+                          int32_t skip_rows, float* out, int64_t max_vals,
+                          int64_t* n_rows, int64_t* n_cols);
+
+/* Multi-threaded copy of n float32 values (host staging path). */
+void tnd_parallel_copy_f32(const float* src, float* dst, int64_t n,
+                           int32_t n_threads);
+
+} /* extern "C" */
+
+#endif /* TND_H */

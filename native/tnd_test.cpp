@@ -1,0 +1,100 @@
+/* Native unit tests for tnd (reference analog: libnd4j tests_cpu gtest
+ * suites, SURVEY §4.1 — same pattern, no gtest dependency needed at this
+ * scale: tiny inputs, exact expectations, assert-style). */
+#include "tnd.h"
+
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+static int failures = 0;
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::printf("FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);        \
+      ++failures;                                                         \
+    }                                                                     \
+  } while (0)
+
+static void test_threshold_roundtrip() {
+  const float g[6] = {0.5f, -0.01f, 0.02f, -2.0f, 0.0f, 0.019f};
+  int64_t enc[6];
+  const int64_t cnt = tnd_threshold_encode(g, 6, 0.02f, enc, 6);
+  CHECK(cnt == 3);
+  CHECK(enc[0] == 1 && enc[1] == 3 && enc[2] == -4);
+  float dec[6] = {0};
+  tnd_threshold_decode(enc, cnt, 0.02f, dec, 6);
+  CHECK(dec[0] == 0.02f && dec[2] == 0.02f && dec[3] == -0.02f);
+  CHECK(dec[1] == 0.0f && dec[4] == 0.0f && dec[5] == 0.0f);
+}
+
+static void test_threshold_residual() {
+  float g[4] = {0.5f, -0.5f, 0.01f, 0.0f};
+  int64_t enc[4];
+  const int64_t cnt = tnd_threshold_encode_residual(g, 4, 0.1f, enc, 4);
+  CHECK(cnt == 2);
+  CHECK(std::fabs(g[0] - 0.4f) < 1e-6f);   // residual = grad - threshold
+  CHECK(std::fabs(g[1] + 0.4f) < 1e-6f);
+  CHECK(g[2] == 0.01f);                     // untouched below threshold
+}
+
+static void test_threshold_overflow() {
+  const float g[4] = {1.f, 1.f, 1.f, 1.f};
+  int64_t enc[2];
+  const int64_t cnt = tnd_threshold_encode(g, 4, 0.5f, enc, 2);
+  CHECK(cnt == -4);  // negative => caller must resize
+}
+
+static void test_bitmap_roundtrip() {
+  const float g[5] = {0.2f, -0.2f, 0.0f, 0.05f, -1.0f};
+  uint8_t packed[2];
+  tnd_bitmap_encode(g, 5, 0.1f, packed);
+  float dec[5];
+  tnd_bitmap_decode(packed, 5, 0.1f, dec);
+  CHECK(dec[0] == 0.1f && dec[1] == -0.1f && dec[2] == 0.0f);
+  CHECK(dec[3] == 0.0f && dec[4] == -0.1f);
+}
+
+static void test_csv_parse() {
+  const char* csv = "h,h,h\n1,2,3\n4.5,-2e1,0.25\n";
+  float out[16];
+  int64_t rows = 0, cols = 0;
+  const int32_t rc = tnd_csv_parse_f32(csv, std::strlen(csv), ',', 1, out, 16,
+                                       &rows, &cols);
+  CHECK(rc == 0);
+  CHECK(rows == 2 && cols == 3);
+  CHECK(out[0] == 1.f && out[4] == -20.f && out[5] == 0.25f);
+
+  // ragged rows rejected
+  const char* bad = "1,2\n3\n";
+  const int32_t rc2 = tnd_csv_parse_f32(bad, std::strlen(bad), ',', 0, out, 16,
+                                        &rows, &cols);
+  CHECK(rc2 == -3);
+
+  // no trailing newline
+  const char* tail = "7,8";
+  const int32_t rc3 = tnd_csv_parse_f32(tail, std::strlen(tail), ',', 0, out,
+                                        16, &rows, &cols);
+  CHECK(rc3 == 0 && rows == 1 && cols == 2 && out[1] == 8.f);
+}
+
+static void test_parallel_copy() {
+  std::vector<float> src(1 << 21), dst(1 << 21, 0.f);
+  for (size_t i = 0; i < src.size(); ++i) src[i] = static_cast<float>(i % 997);
+  tnd_parallel_copy_f32(src.data(), dst.data(), src.size(), 4);
+  CHECK(std::memcmp(src.data(), dst.data(), src.size() * sizeof(float)) == 0);
+}
+
+int main() {
+  CHECK(tnd_version() == 1);
+  test_threshold_roundtrip();
+  test_threshold_residual();
+  test_threshold_overflow();
+  test_bitmap_roundtrip();
+  test_csv_parse();
+  test_parallel_copy();
+  if (failures == 0) std::printf("ALL NATIVE TESTS PASSED\n");
+  return failures == 0 ? 0 : 1;
+}

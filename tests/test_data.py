@@ -1,0 +1,117 @@
+"""Data pipeline tests (SURVEY §2.3 datavec, §2.4 C12 datasets/iterators)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    DataSet,
+    FileSplit,
+    ImagePreProcessingScaler,
+    IrisDataSetIterator,
+    LineRecordReader,
+    MnistDataSetIterator,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    RecordReaderDataSetIterator,
+    Schema,
+    TransformProcess,
+)
+
+
+def test_csv_record_reader(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("h1,h2,h3\n1,2,0\n4,5,1\n7,8,2\n")
+    rr = CSVRecordReader(skip_num_lines=1).initialize(FileSplit(str(p)))
+    rows = list(rr)
+    assert rows == [["1", "2", "0"], ["4", "5", "1"], ["7", "8", "2"]]
+    rr.reset()
+    assert rr.has_next()
+
+
+def test_record_reader_dataset_iterator(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("".join(f"{i},{i*2},{i%3}\n" for i in range(10)))
+    rr = CSVRecordReader().initialize(FileSplit(str(p)))
+    it = RecordReaderDataSetIterator(rr, batch_size=4, label_index=-1, num_classes=3)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (4, 2)
+    assert batches[0].labels.shape == (4, 3)
+    assert batches[-1].features.shape == (2, 2)  # remainder
+    np.testing.assert_allclose(batches[0].labels[1], [0, 1, 0])  # i=1 -> class 1
+
+
+def test_transform_process_roundtrip():
+    schema = (Schema.Builder()
+              .add_column_string("name")
+              .add_column_categorical("color", "red", "green", "blue")
+              .add_column_double("size")
+              .build())
+    tp = (TransformProcess.Builder(schema)
+          .string_map_transform("name", "lower")
+          .categorical_to_one_hot("color")
+          .double_math_op("size", "Multiply", 2.0)
+          .remove_columns("name")
+          .build())
+    rows = [["Alice", "red", 1.5], ["BOB", "blue", 3.0]]
+    out = tp.execute(rows)
+    assert out == [[1, 0, 0, 3.0], [0, 0, 1, 6.0]]
+    assert tp.final_schema().names() == ["color[red]", "color[green]", "color[blue]", "size"]
+    # JSON round-trip executes identically (serialization invariant)
+    tp2 = TransformProcess.from_json(tp.to_json())
+    assert tp2.execute(rows) == out
+
+
+def test_normalizer_standardize_roundtrip():
+    rs = np.random.RandomState(0)
+    x = rs.randn(100, 5).astype(np.float32) * 3 + 7
+    ds = DataSet(x.copy(), None)
+    n = NormalizerStandardize().fit(ds)
+    n.transform(ds)
+    assert abs(float(ds.features.mean())) < 1e-4
+    assert abs(float(ds.features.std()) - 1.0) < 1e-2
+    back = n.revert_features(ds.features)
+    np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+def test_normalizer_serialization(tmp_path):
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    n = NormalizerMinMaxScaler().fit(DataSet(x, None))
+    p = str(tmp_path / "norm.json")
+    n.save(p)
+    n2 = NormalizerMinMaxScaler.restore(p)
+    ds = DataSet(x.copy(), None)
+    n2.transform(ds)
+    assert float(ds.features.min()) == 0.0 and float(ds.features.max()) == 1.0
+
+
+def test_image_scaler():
+    ds = DataSet(np.full((2, 1, 4, 4), 255.0, np.float32), None)
+    ImagePreProcessingScaler().transform(ds)
+    np.testing.assert_allclose(ds.features, 1.0)
+
+
+def test_iris_iterator():
+    it = IrisDataSetIterator(batch_size=50)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (50, 4)
+    assert batches[0].labels.shape == (50, 3)
+    # shuffled split contains all three classes in first batch
+    assert batches[0].labels.sum(axis=0).min() > 0
+
+
+def test_mnist_iterator_and_lenet_slice():
+    """BASELINE config #1 minimum end-to-end slice (SURVEY §7.1 M3): LeNet +
+    MNIST iterator + Adam + Evaluation. Synthetic fallback in zero-egress
+    envs; accuracy must beat chance decisively after one epoch."""
+    from deeplearning4j_tpu.models import LeNet
+
+    train = MnistDataSetIterator(batch_size=64, train=True, num_examples=1024)
+    test = MnistDataSetIterator(batch_size=256, train=False, num_examples=512)
+    net = LeNet().init()
+    net.fit(train, epochs=2)
+    ev = net.evaluate(test)
+    assert ev.accuracy() > 0.8, ev.accuracy()

@@ -1,0 +1,52 @@
+"""Native tnd library tests: build via ctypes wrapper, parity vs numpy
+fallbacks (SURVEY §2.9 N15/N13 — codecs + C ABI + bindings)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.parallel import compression
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_native_threshold_matches_numpy():
+    rs = np.random.RandomState(0)
+    g = (rs.randn(10_000) * 1e-3).astype(np.float32)
+    enc_n = native.threshold_encode(g, 1e-3)
+    flat = g.reshape(-1)
+    idx = np.nonzero(np.abs(flat) >= 1e-3)[0]
+    enc_p = np.concatenate([[flat.size], ((idx + 1) * np.sign(flat[idx])).astype(np.int64)])
+    np.testing.assert_array_equal(enc_n, enc_p.astype(np.int64))
+    dec = native.threshold_decode(enc_n, 1e-3)
+    assert dec.shape == (10_000,)
+    assert np.all(np.sign(dec[idx]) == np.sign(flat[idx]))
+
+
+def test_native_residual_reconstructs():
+    rs = np.random.RandomState(1)
+    g = (rs.randn(5_000) * 2e-3).astype(np.float32)
+    enc, residual = native.threshold_encode_residual(g, 1e-3)
+    dec = native.threshold_decode(enc, 1e-3)
+    np.testing.assert_allclose(dec + residual, g, atol=1e-6)
+
+
+def test_compression_module_uses_native():
+    rs = np.random.RandomState(2)
+    g = (rs.randn(1_000) * 1e-3).astype(np.float32)
+    enc, residual = compression.threshold_residual(g, 1e-3)
+    dec = compression.threshold_decode(enc, 1e-3)
+    np.testing.assert_allclose(dec + residual, g.reshape(-1), atol=1e-6)
+
+
+def test_native_csv_parse(tmp_path):
+    from deeplearning4j_tpu.data.records import load_csv_f32
+
+    p = tmp_path / "m.csv"
+    p.write_text("a,b,c\n1,2.5,-3e2\n4,5,6\n")
+    arr = load_csv_f32(str(p), skip_rows=1)
+    np.testing.assert_allclose(arr, [[1, 2.5, -300], [4, 5, 6]])
+    p2 = tmp_path / "bad.csv"
+    p2.write_text("x,y\nfoo,bar\n")
+    assert load_csv_f32(str(p2), skip_rows=1) is None

@@ -1,0 +1,128 @@
+"""Early stopping + transfer learning tests (SURVEY §2.4 C10/C11)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    FineTuneConfiguration,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.earlystopping import (
+    DataSetLossCalculator,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+
+
+def _net(lr=0.02, seed=11):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(lr)).list()
+            .layer(DenseLayer(n_in=5, n_out=16, activation="tanh"))
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iters(seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(120, 5).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[np.argmax(X[:, :3], 1)]
+    train = ListDataSetIterator([DataSet(X[i:i + 40], Y[i:i + 40]) for i in range(0, 80, 40)])
+    val = ListDataSetIterator([DataSet(X[80:], Y[80:])])
+    return train, val
+
+
+def test_early_stopping_max_epochs():
+    train, val = _iters()
+    net = _net()
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+           .score_calculator(DataSetLossCalculator(val))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.total_epochs <= 5
+    assert len(result.score_vs_epoch) == result.total_epochs
+    assert result.best_model_score <= result.score_vs_epoch[0]
+    best = result.get_best_model()
+    assert best is not None
+
+
+def test_early_stopping_patience_stops_before_max():
+    train, val = _iters()
+    net = _net(lr=0.0)  # lr=0 -> no improvement -> patience fires immediately
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(
+               ScoreImprovementEpochTerminationCondition(2),
+               MaxEpochsTerminationCondition(50))
+           .score_calculator(DataSetLossCalculator(val))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.total_epochs < 50
+    assert result.termination_details == "ScoreImprovementEpochTerminationCondition"
+
+
+def test_early_stopping_divergence_abort():
+    train, val = _iters()
+    # absurd SGD lr + unbounded activations -> divergence (Adam would
+    # normalize the step away; tanh would bound the logits)
+    conf = (NeuralNetConfiguration.Builder().seed(11).updater(Sgd(500.0)).list()
+            .layer(DenseLayer(n_in=5, n_out=16, activation="identity"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+           .iteration_termination_conditions(MaxScoreIterationTerminationCondition(1e3))
+           .score_calculator(DataSetLossCalculator(val))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+
+
+def test_transfer_learning_freeze_and_replace():
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, 5).astype(np.float32)
+    Y3 = np.eye(3, dtype=np.float32)[np.argmax(X[:, :3], 1)]
+    base = _net()
+    base.fit(DataSet(X, Y3))
+    frozen_w_before = np.asarray(base.params_["0"]["W"])
+
+    # new 4-class head; freeze layers 0-1
+    Y4 = np.eye(4, dtype=np.float32)[np.argmax(X[:, :4], 1)]
+    new = (TransferLearning.Builder(base)
+           .fine_tune_configuration(FineTuneConfiguration.Builder().updater(Sgd(0.1)).build())
+           .set_feature_extractor(1)
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_in=8, n_out=4, activation="softmax", loss="mcxent"))
+           .build())
+    # retained weights copied
+    np.testing.assert_allclose(np.asarray(new.params_["0"]["W"]), frozen_w_before)
+    for _ in range(3):
+        new.fit(DataSet(X, Y4))
+    # frozen layers unchanged, head trained
+    np.testing.assert_allclose(np.asarray(new.params_["0"]["W"]), frozen_w_before)
+    assert new.output(X).numpy().shape == (32, 4)
+
+
+def test_transfer_learning_helper_featurize():
+    base = _net()
+    rs = np.random.RandomState(0)
+    X = rs.randn(16, 5).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[np.argmax(X[:, :3], 1)]
+    helper = TransferLearningHelper(base, frozen_until=0)
+    feat = helper.featurize(DataSet(X, Y))
+    assert feat.features.shape == (16, 16)  # first dense layer output
+    head = helper.unfrozen_mln()
+    out_full = base.output(X).numpy()
+    out_head = head.output(feat.features).numpy()
+    np.testing.assert_allclose(out_full, out_head, atol=1e-5)
