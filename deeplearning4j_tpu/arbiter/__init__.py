@@ -1,0 +1,41 @@
+"""Hyperparameter optimization (arbiter parity).
+
+Reference: ``arbiter-core`` + ``arbiter-deeplearning4j`` (SURVEY §2.7 A1/A2):
+``ParameterSpace<T>`` tree with leaf spaces (continuous/integer/discrete),
+candidate generators (random / grid / genetic), ``LocalOptimizationRunner``
+(score functions, termination conditions, result tracking), and
+``MultiLayerSpace`` mirroring the network builders with spaces at every
+hyperparameter.
+"""
+
+from .optimize import (
+    CandidateGenerator,
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    GeneticSearchCandidateGenerator,
+    GridSearchCandidateGenerator,
+    IntegerParameterSpace,
+    LocalOptimizationRunner,
+    MaxCandidatesCondition,
+    MaxTimeCondition,
+    OptimizationResult,
+    ParameterSpace,
+    RandomSearchGenerator,
+)
+from .spaces import MultiLayerSpace
+
+__all__ = [
+    "ParameterSpace",
+    "ContinuousParameterSpace",
+    "IntegerParameterSpace",
+    "DiscreteParameterSpace",
+    "CandidateGenerator",
+    "RandomSearchGenerator",
+    "GridSearchCandidateGenerator",
+    "GeneticSearchCandidateGenerator",
+    "LocalOptimizationRunner",
+    "OptimizationResult",
+    "MaxCandidatesCondition",
+    "MaxTimeCondition",
+    "MultiLayerSpace",
+]

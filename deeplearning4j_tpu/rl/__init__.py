@@ -1,0 +1,22 @@
+"""Reinforcement learning (rl4j parity).
+
+Reference: ``rl4j-core`` (SURVEY §2.7 R1): ``MDP`` interface + observation/
+action spaces, ``QLearningDiscrete`` (ExpReplay buffer, target-network sync,
+eps-greedy anneal), ``DQNPolicy``, ``HistoryProcessor`` frame stacking,
+``DQNFactoryStdDense``. Async family (A3C/AsyncNStepQ) is round-2 scope —
+the sync DQN path covers the QLearning baseline.
+"""
+
+from .mdp import MDP, DiscreteSpace, ObservationSpace
+from .qlearning import DQNFactoryStdDense, DQNPolicy, ExpReplay, QLearningConfiguration, QLearningDiscrete
+
+__all__ = [
+    "MDP",
+    "DiscreteSpace",
+    "ObservationSpace",
+    "ExpReplay",
+    "QLearningConfiguration",
+    "QLearningDiscrete",
+    "DQNPolicy",
+    "DQNFactoryStdDense",
+]

@@ -1,0 +1,220 @@
+"""DQN: replay buffer, target network, eps-greedy Q-learning.
+
+Reference: ``org.deeplearning4j.rl4j.learning.sync.qlearning.discrete.
+QLearningDiscrete`` (SURVEY §2.7 R1): ExpReplay buffer, target-net sync
+every ``target_dqn_update_freq`` steps, eps-greedy annealed over
+``eps_anneal_steps``, double-DQN option; ``policy.DQNPolicy``;
+``network.dqn.DQNFactoryStdDense``.
+
+TPU-native: the Q-update (gather Q(s,a), TD target from the target net,
+MSE grad, updater apply) is ONE jitted step over the whole replay batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Deque, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.conf import DenseLayer, NeuralNetConfiguration, OutputLayer
+from ..nn.multilayer import MultiLayerNetwork
+from ..nn.updaters import Adam
+from .mdp import MDP
+
+
+class ExpReplay:
+    """Ring-buffer experience replay (learning.sync.ExpReplay)."""
+
+    def __init__(self, max_size: int = 10000, batch_size: int = 32, seed: int = 0):
+        self.buffer: Deque = deque(maxlen=max_size)
+        self.batch_size = batch_size
+        self.rs = np.random.RandomState(seed)
+
+    def store(self, s, a, r, s2, done):
+        self.buffer.append((s, a, r, s2, done))
+
+    def sample(self) -> Tuple[np.ndarray, ...]:
+        idx = self.rs.randint(0, len(self.buffer), self.batch_size)
+        s, a, r, s2, d = zip(*[self.buffer[i] for i in idx])
+        return (np.stack(s), np.asarray(a, np.int32), np.asarray(r, np.float32),
+                np.stack(s2), np.asarray(d, np.float32))
+
+    def __len__(self):
+        return len(self.buffer)
+
+
+@dataclasses.dataclass
+class QLearningConfiguration:
+    """QLearning.QLConfiguration parity (field names kept)."""
+
+    seed: int = 123
+    max_epoch_step: int = 200
+    max_step: int = 5000
+    exp_rep_max_size: int = 10000
+    batch_size: int = 32
+    target_dqn_update_freq: int = 100
+    update_start: int = 100
+    reward_factor: float = 1.0
+    gamma: float = 0.99
+    error_clamp: float = 1.0
+    min_epsilon: float = 0.05
+    eps_anneal_steps: int = 1000
+    double_dqn: bool = True
+
+
+class DQNFactoryStdDense:
+    """network.dqn.DQNFactoryStdDense: MLP Q-network builder."""
+
+    @staticmethod
+    def build(n_in: int, n_out: int, hidden: int = 64, n_layers: int = 2,
+              lr: float = 1e-3, seed: int = 0) -> MultiLayerNetwork:
+        b = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(lr))
+             .weight_init("xavier").list())
+        for i in range(n_layers):
+            b = b.layer(DenseLayer(n_in=n_in if i == 0 else hidden,
+                                   n_out=hidden, activation="relu"))
+        conf = b.layer(OutputLayer(n_out=n_out, activation="identity", loss="mse")).build()
+        return MultiLayerNetwork(conf).init()
+
+
+class QLearningDiscrete:
+    def __init__(self, mdp: MDP, config: QLearningConfiguration = None,
+                 q_network: Optional[MultiLayerNetwork] = None, hidden: int = 64):
+        self.mdp = mdp
+        self.cfg = config or QLearningConfiguration()
+        n_in = int(np.prod(mdp.observation_space.shape))
+        n_act = mdp.action_space.size
+        self.qnet = q_network or DQNFactoryStdDense.build(
+            n_in, n_act, hidden=hidden, seed=self.cfg.seed)
+        self.target_params = jax.tree.map(jnp.copy, self.qnet.params_)
+        self.replay = ExpReplay(self.cfg.exp_rep_max_size, self.cfg.batch_size,
+                                self.cfg.seed)
+        self.rs = np.random.RandomState(self.cfg.seed)
+        self.step_count = 0
+        self.epoch_rewards: List[float] = []
+        self._jit = None
+
+    # ---------------------------------------------------------------- q step
+
+    def _train_step(self):
+        if self._jit is not None:
+            return self._jit
+        net = self.qnet
+        cfg = self.cfg
+        updater = net.conf.updater
+
+        def q_values(params, x):
+            h, _, _ = net._forward(params, net.bn_state, x, training=False, rng=None)
+            i = len(net.conf.layers) - 1
+            layer = net.conf.layers[i]
+            return layer.forward(params.get(str(i), {}), h, net._input_types[i],
+                                 training=False, rng=None)
+
+        def step(params, target_params, upd_state, iteration, s, a, r, s2, done):
+            q_next_t = q_values(target_params, s2)
+            if cfg.double_dqn:
+                # double DQN: argmax from online net, value from target net
+                a_star = jnp.argmax(q_values(params, s2), axis=1)
+                q_next = jnp.take_along_axis(q_next_t, a_star[:, None], 1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_t, axis=1)
+            target = r + cfg.gamma * (1.0 - done) * q_next
+
+            def loss_fn(p):
+                q = q_values(p, s)
+                qa = jnp.take_along_axis(q, a[:, None], 1)[:, 0]
+                # error clamp = Huber loss (linear beyond the clamp), NOT a
+                # hard clip of the TD error — clipping inside a squared loss
+                # would zero the gradient exactly where learning is needed
+                td = jnp.abs(qa - target)
+                clamp = cfg.error_clamp
+                return jnp.mean(jnp.where(
+                    td <= clamp, 0.5 * jnp.square(td),
+                    clamp * (td - 0.5 * clamp)))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, new_upd = updater.apply(grads, upd_state, params, iteration, 0)
+            new_params = jax.tree.map(lambda p, u: p - u, params, updates)
+            return new_params, new_upd, loss
+
+        self._jit = jax.jit(step, donate_argnums=(0, 2))
+        return self._jit
+
+    # ------------------------------------------------------------------ act
+
+    def epsilon(self) -> float:
+        frac = min(1.0, self.step_count / max(1, self.cfg.eps_anneal_steps))
+        return 1.0 + frac * (self.cfg.min_epsilon - 1.0)
+
+    def act(self, obs: np.ndarray, greedy: bool = False) -> int:
+        if not greedy and self.rs.rand() < self.epsilon():
+            return self.mdp.action_space.random_action(self.rs)
+        q = self.qnet.output(obs[None].reshape(1, -1)).numpy()
+        return int(np.argmax(q[0]))
+
+    # ---------------------------------------------------------------- train
+
+    def train(self) -> List[float]:
+        """Run until cfg.max_step env steps; returns per-epoch rewards."""
+        cfg = self.cfg
+        while self.step_count < cfg.max_step:
+            obs = self.mdp.reset()
+            ep_reward, ep_steps = 0.0, 0
+            while not self.mdp.is_done() and ep_steps < cfg.max_epoch_step:
+                a = self.act(obs)
+                obs2, r, done, _ = self.mdp.step(a)
+                self.replay.store(obs.reshape(-1), a, r * cfg.reward_factor,
+                                  obs2.reshape(-1), float(done))
+                obs = obs2
+                ep_reward += r
+                ep_steps += 1
+                self.step_count += 1
+                if self.step_count >= cfg.update_start and len(self.replay) >= cfg.batch_size:
+                    self._learn()
+                if self.step_count % cfg.target_dqn_update_freq == 0:
+                    self.target_params = jax.tree.map(jnp.copy, self.qnet.params_)
+                if self.step_count >= cfg.max_step:
+                    break
+            self.epoch_rewards.append(ep_reward)
+        return self.epoch_rewards
+
+    def _learn(self):
+        s, a, r, s2, d = self.replay.sample()
+        step = self._train_step()
+        self.qnet.params_, self.qnet.updater_state, loss = step(
+            self.qnet.params_, self.target_params, self.qnet.updater_state,
+            jnp.asarray(self.qnet.iteration, jnp.int32),
+            jnp.asarray(s), jnp.asarray(a), jnp.asarray(r), jnp.asarray(s2),
+            jnp.asarray(d))
+        self.qnet.iteration += 1
+
+    def get_policy(self) -> "DQNPolicy":
+        return DQNPolicy(self.qnet)
+
+    getPolicy = get_policy
+
+
+class DQNPolicy:
+    """policy.DQNPolicy: greedy play."""
+
+    def __init__(self, qnet: MultiLayerNetwork):
+        self.qnet = qnet
+
+    def next_action(self, obs: np.ndarray) -> int:
+        q = self.qnet.output(np.asarray(obs).reshape(1, -1)).numpy()
+        return int(np.argmax(q[0]))
+
+    nextAction = next_action
+
+    def play(self, mdp: MDP, max_steps: int = 1000) -> float:
+        obs = mdp.reset()
+        total, steps = 0.0, 0
+        while not mdp.is_done() and steps < max_steps:
+            obs, r, _, _ = mdp.step(self.next_action(obs))
+            total += r
+            steps += 1
+        return total

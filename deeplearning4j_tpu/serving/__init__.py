@@ -1,0 +1,10 @@
+"""Model serving: JSON HTTP inference endpoint.
+
+Reference: ``deeplearning4j-remote`` / ``nd4j-remote`` ``JsonModelServer``
+(SURVEY §2.6 S7): HTTP endpoint wrapping MLN/CG/SameDiff (and
+ParallelInference for batching) with typed (de)serializers.
+"""
+
+from .json_server import JsonModelServer, JsonModelClient
+
+__all__ = ["JsonModelServer", "JsonModelClient"]

@@ -1,0 +1,120 @@
+"""JsonModelServer — HTTP JSON inference over any model with output().
+
+Reference: ``org.deeplearning4j.remote.JsonModelServer`` (SURVEY §2.6 S7):
+POST /predict with a JSON body → typed deserializer → model → serializer →
+JSON response; batching via ParallelInference underneath when provided.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class JsonModelServer:
+    def __init__(self, model, port: int = 0,
+                 deserializer: Optional[Callable[[Any], np.ndarray]] = None,
+                 serializer: Optional[Callable[[np.ndarray], Any]] = None,
+                 endpoint: str = "/predict"):
+        self.model = model
+        self.deserializer = deserializer or (lambda d: np.asarray(d, np.float32))
+        self.serializer = serializer or (lambda a: np.asarray(a).tolist())
+        self.endpoint = endpoint
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.port = port
+        self._lock = threading.Lock()
+
+    # -- builder parity ----------------------------------------------------
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._kw = {}
+
+        def port(self, p: int):
+            self._kw["port"] = p
+            return self
+
+        def inference_adapter(self, deserializer, serializer):
+            self._kw["deserializer"] = deserializer
+            self._kw["serializer"] = serializer
+            return self
+
+        def endpoint(self, e: str):
+            self._kw["endpoint"] = e
+            return self
+
+        def build(self) -> "JsonModelServer":
+            return JsonModelServer(self._model, **self._kw)
+
+    def _predict(self, payload: Any) -> Any:
+        x = self.deserializer(payload)
+        with self._lock:  # model state is not re-entrant under donation
+            out = self.model.output(x)
+        arr = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+        return self.serializer(arr)
+
+    def start(self) -> "JsonModelServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != server.endpoint:
+                    self._json({"error": "unknown endpoint"}, 404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    self._json({"output": server._predict(payload)})
+                except Exception as e:  # serving endpoint must not die
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._json({"status": "ok"})
+                else:
+                    self._json({"error": "POST " + server.endpoint}, 404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class JsonModelClient:
+    """Tiny client (nd4j-json-client parity) using stdlib urllib."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9090, endpoint: str = "/predict"):
+        self.url = f"http://{host}:{port}{endpoint}"
+
+    def predict(self, data) -> Any:
+        import urllib.request
+
+        body = json.dumps(np.asarray(data).tolist()).encode()
+        req = urllib.request.Request(self.url, data=body,
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return out["output"]

@@ -1,0 +1,21 @@
+"""Training UI + stats pipeline.
+
+Reference: ``deeplearning4j-ui-parent`` (SURVEY §2.4 C14, §5.5):
+``StatsListener`` emits per-iteration stats (score, lr, per-layer
+param/gradient/update histograms & ratios, system/memory) into a
+``StatsStorage`` (in-memory | file-backed), and a web ``UIServer`` renders
+them. The storage-decoupled-from-server design is kept (SURVEY calls it
+good); SBE encoding + Vert.x become JSON lines + http.server.
+"""
+
+from .stats import FileStatsStorage, InMemoryStatsStorage, StatsListener
+from .server import UIServer
+from .profiling import ProfilingListener
+
+__all__ = [
+    "StatsListener",
+    "InMemoryStatsStorage",
+    "FileStatsStorage",
+    "UIServer",
+    "ProfilingListener",
+]

@@ -1,0 +1,85 @@
+"""ProfilingListener — Chrome-trace JSON emission + A/B diffing.
+
+Reference: ``org.nd4j.autodiff.listeners.profiler.ProfilingListener`` emits
+chrome://tracing-compatible trace-event JSON; ``comparison.ProfileAnalyzer``
+diffs two traces (SURVEY §5.1). On TPU the inside-the-step timeline belongs
+to the XLA profiler; this listener captures the HOST-side step cadence
+(dispatch, blocking fetch, ETL gaps) which is where host-bound regressions
+show up.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ProfilingListener:
+    def __init__(self, output_path: Optional[str] = None):
+        self.output_path = output_path
+        self.events: List[Dict[str, Any]] = []
+        self._last_end: Optional[float] = None
+        self._origin = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._origin) * 1e6
+
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        end = self._now_us()
+        if self._last_end is not None:
+            self.events.append({
+                "name": "train_step",
+                "ph": "X",
+                "ts": self._last_end,
+                "dur": end - self._last_end,
+                "pid": 0,
+                "tid": 0,
+                "args": {"iteration": iteration, "epoch": epoch,
+                         "score": float(model.score_)},
+            })
+        self._last_end = end
+        if self.output_path and iteration % 50 == 0:
+            self.flush()
+
+    def flush(self) -> None:
+        if self.output_path:
+            with open(self.output_path, "w") as f:
+                json.dump({"traceEvents": self.events}, f)
+
+    def on_epoch_end(self, model) -> None:
+        self.flush()
+
+
+class ProfileAnalyzer:
+    """comparison.ProfileAnalyzer parity: summarize + diff two traces."""
+
+    @staticmethod
+    def summarize(trace: Dict[str, Any]) -> Dict[str, float]:
+        durs = [e["dur"] for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+        if not durs:
+            return {"events": 0}
+        durs.sort()
+        n = len(durs)
+        return {
+            "events": n,
+            "total_us": sum(durs),
+            "mean_us": sum(durs) / n,
+            "p50_us": durs[n // 2],
+            "p90_us": durs[int(n * 0.9)],
+            "max_us": durs[-1],
+        }
+
+    @staticmethod
+    def compare(trace_a: Dict[str, Any], trace_b: Dict[str, Any]) -> Dict[str, Any]:
+        a, b = ProfileAnalyzer.summarize(trace_a), ProfileAnalyzer.summarize(trace_b)
+        return {
+            "a": a,
+            "b": b,
+            "mean_speedup": (a.get("mean_us", 0) / b["mean_us"]) if b.get("mean_us") else None,
+        }
+
+    @staticmethod
+    def load(path: str) -> Dict[str, Any]:
+        with open(path) as f:
+            return json.load(f)

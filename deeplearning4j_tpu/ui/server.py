@@ -1,0 +1,152 @@
+"""UIServer — training dashboard over a StatsStorage.
+
+Reference: ``org.deeplearning4j.ui.api.UIServer`` → ``VertxUIServer``
+(SURVEY §2.4 C14): overview (score chart) / model / system tabs. Here: a
+stdlib http.server serving (a) JSON endpoints over the attached storage and
+(b) one self-contained HTML page that polls and draws the score curve +
+update ratios with inline canvas — no JS deps, zero-egress friendly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from .stats import StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu — training UI</title>
+<style>
+ body{font-family:sans-serif;margin:20px;background:#fafafa}
+ h2{margin:8px 0} canvas{border:1px solid #ccc;background:#fff}
+ #meta{color:#555;margin-bottom:12px}
+</style></head><body>
+<h2>Training overview</h2><div id="meta"></div>
+<canvas id="score" width="900" height="260"></canvas>
+<h2>Update : parameter ratios (log10)</h2>
+<canvas id="ratios" width="900" height="260"></canvas>
+<script>
+function draw(cv, series, logscale){
+  const ctx=cv.getContext('2d');ctx.clearRect(0,0,cv.width,cv.height);
+  const names=Object.keys(series); if(!names.length) return;
+  let xs=[],ys=[];
+  names.forEach(n=>{series[n].forEach(p=>{xs.push(p[0]);ys.push(p[1]);});});
+  ys=ys.filter(v=>isFinite(v)); if(!ys.length) return;
+  const x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
+  const sx=v=>40+(cv.width-60)*(v-x0)/Math.max(1e-9,x1-x0);
+  const sy=v=>cv.height-25-(cv.height-45)*(v-y0)/Math.max(1e-9,y1-y0);
+  ctx.strokeStyle='#999';ctx.strokeRect(40,20,cv.width-60,cv.height-45);
+  ctx.fillStyle='#555';ctx.fillText(y1.toPrecision(4),2,25);
+  ctx.fillText(y0.toPrecision(4),2,cv.height-25);
+  const colors=['#1565c0','#c62828','#2e7d32','#6a1b9a','#ef6c00','#00838f'];
+  names.forEach((n,i)=>{
+    ctx.strokeStyle=colors[i%colors.length];ctx.beginPath();
+    series[n].forEach((p,j)=>{const X=sx(p[0]),Y=sy(p[1]);j?ctx.lineTo(X,Y):ctx.moveTo(X,Y);});
+    ctx.stroke();
+    ctx.fillStyle=colors[i%colors.length];ctx.fillText(n,50+i*140,14);
+  });
+}
+async function tick(){
+  const r=await fetch('/data');const d=await r.json();
+  document.getElementById('meta').textContent=
+    `session ${d.session} — ${d.records} records — last score ${d.last_score}`;
+  draw(document.getElementById('score'),{score:d.score},false);
+  draw(document.getElementById('ratios'),d.ratios,true);
+}
+tick();setInterval(tick,2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage: StatsStorage = None  # injected
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path in ("/", "/train", "/train/overview"):
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path == "/sessions":
+            self._json(self.storage.session_ids())
+            return
+        if self.path.startswith("/records"):
+            self._json(self.storage.records())
+            return
+        if self.path == "/data":
+            recs = self.storage.records()
+            score = [[r["iteration"], r["score"]] for r in recs if "score" in r]
+            ratios = {}
+            import math
+
+            for r in recs:
+                for k, v in (r.get("update_ratios") or {}).items():
+                    if v > 0:
+                        ratios.setdefault(k, []).append([r["iteration"], math.log10(v)])
+            self._json({
+                "session": recs[-1].get("session") if recs else None,
+                "records": len(recs),
+                "last_score": recs[-1].get("score") if recs else None,
+                "score": score,
+                "ratios": ratios,
+            })
+            return
+        self._json({"error": "not found"}, 404)
+
+
+class UIServer:
+    """UIServer.getInstance().attach(statsStorage) parity."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._storages: List[StatsStorage] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    getInstance = get_instance
+
+    def attach(self, storage: StatsStorage) -> None:
+        self._storages.append(storage)
+        if self._httpd is None:
+            self._start(storage)
+        else:
+            self._httpd.RequestHandlerClass.storage = storage
+
+    def _start(self, storage: StatsStorage):
+        handler = type("BoundHandler", (_Handler,), {"storage": storage})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        UIServer._instance = None
+
+    detach = stop

@@ -1,0 +1,144 @@
+"""StatsListener + StatsStorage.
+
+Reference: ``org.deeplearning4j.ui.model.stats.StatsListener`` (SBE-encoded
+StatsReport: scores, lr, per-layer param/gradient/update stddevs, histograms,
+update:param ratios, memory/GC) + ``storage.{InMemoryStatsStorage,
+FileStatsStorage}`` (SURVEY §2.4 C14). Reports here are plain dicts; file
+storage is JSON-lines (append-only, tail-able).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class StatsStorage:
+    def put_record(self, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def records(self, session_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def session_ids(self) -> List[str]:
+        return sorted({r.get("session", "default") for r in self.records()})
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._records: List[Dict[str, Any]] = []
+
+    def put_record(self, record):
+        self._records.append(record)
+
+    def records(self, session_id=None):
+        if session_id is None:
+            return list(self._records)
+        return [r for r in self._records if r.get("session") == session_id]
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSON-lines file (reference: MapDB-backed FileStatsStorage)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def put_record(self, record):
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def records(self, session_id=None):
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                r = json.loads(line)
+                if session_id is None or r.get("session") == session_id:
+                    out.append(r)
+        return out
+
+
+def _layer_stats(tree) -> Dict[str, Dict[str, float]]:
+    out = {}
+    for layer_key, lp in sorted(tree.items()):
+        for name, w in sorted(lp.items()) if isinstance(lp, dict) else []:
+            a = np.asarray(w)
+            out[f"{layer_key}/{name}"] = {
+                "mean": float(a.mean()),
+                "std": float(a.std()),
+                "min": float(a.min()),
+                "max": float(a.max()),
+            }
+    return out
+
+
+class StatsListener:
+    """TrainingListener emitting StatsReport records every
+    ``frequency`` iterations. Stats math happens host-side on fetched
+    arrays — cheap at default frequency; raise it for big models."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 10,
+                 session_id: str = "default", collect_histograms: bool = False,
+                 histogram_bins: int = 20):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.session_id = session_id
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self._last_params: Optional[Dict] = None
+        self._start = time.time()
+
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        if iteration % self.frequency:
+            return
+        record: Dict[str, Any] = {
+            "session": self.session_id,
+            "iteration": iteration,
+            "epoch": epoch,
+            "time": time.time() - self._start,
+            "score": float(model.score_),
+        }
+        lr = getattr(model.conf.updater, "learning_rate", None)
+        if lr is not None:
+            record["lr"] = float(lr)
+        params = model.params_
+        record["params"] = _layer_stats(params)
+        # update:parameter ratio (the UI's most useful signal): ||delta||/||w||
+        if self._last_params is not None:
+            ratios = {}
+            for k, lp in params.items():
+                if k not in self._last_params or not isinstance(lp, dict):
+                    continue
+                for name, w in lp.items():
+                    prev = self._last_params[k].get(name)
+                    if prev is None:
+                        continue
+                    wn = float(np.linalg.norm(np.asarray(w).reshape(-1)))
+                    dn = float(np.linalg.norm(
+                        (np.asarray(w) - prev).reshape(-1)))
+                    ratios[f"{k}/{name}"] = dn / (wn + 1e-12)
+            record["update_ratios"] = ratios
+        if self.collect_histograms:
+            record["histograms"] = {
+                f"{k}/{name}": np.histogram(np.asarray(w).reshape(-1),
+                                            bins=self.histogram_bins)[0].tolist()
+                for k, lp in params.items() if isinstance(lp, dict)
+                for name, w in lp.items()
+            }
+        self._last_params = {
+            k: {name: np.asarray(w).copy() for name, w in lp.items()}
+            for k, lp in params.items() if isinstance(lp, dict)
+        }
+        self.storage.put_record(record)
+
+    def on_epoch_end(self, model) -> None:
+        return None

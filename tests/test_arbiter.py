@@ -1,0 +1,99 @@
+"""Arbiter hyperparameter-search tests (SURVEY §2.7 A1/A2)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    GeneticSearchCandidateGenerator,
+    GridSearchCandidateGenerator,
+    IntegerParameterSpace,
+    LocalOptimizationRunner,
+    MaxCandidatesCondition,
+    MultiLayerSpace,
+    RandomSearchGenerator,
+)
+from deeplearning4j_tpu.arbiter.spaces import LayerSpace
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def test_parameter_spaces():
+    c = ContinuousParameterSpace(0.001, 0.1, log_scale=True)
+    assert 0.001 <= c.value(0.0) < c.value(0.999) <= 0.1
+    i = IntegerParameterSpace(4, 8)
+    assert set(i.grid_points(10)) == {4, 5, 6, 7, 8}
+    d = DiscreteParameterSpace("relu", "tanh")
+    assert d.value(0.0) == "relu" and d.value(0.99) == "tanh"
+
+
+def test_grid_generator_enumerates_product():
+    gen = GridSearchCandidateGenerator(
+        {"a": DiscreteParameterSpace(1, 2), "b": DiscreteParameterSpace("x", "y", "z")})
+    cands = []
+    while gen.has_more():
+        cands.append(tuple(gen.next_candidate().values()))
+    assert len(cands) == 6 and len(set(cands)) == 6
+
+
+def test_runner_finds_quadratic_minimum():
+    spaces = {"x": ContinuousParameterSpace(-5, 5), "y": ContinuousParameterSpace(-5, 5)}
+    runner = LocalOptimizationRunner(
+        RandomSearchGenerator(spaces, seed=3),
+        lambda c: (c["x"] - 1.0) ** 2 + (c["y"] + 2.0) ** 2,
+        [MaxCandidatesCondition(200)])
+    res = runner.execute()
+    assert res.best_score < 0.5
+    assert abs(res.best_candidate["x"] - 1.0) < 1.0
+    assert abs(res.best_candidate["y"] + 2.0) < 1.0
+
+
+def test_genetic_beats_random_on_budget():
+    spaces = {f"x{i}": ContinuousParameterSpace(-3, 3) for i in range(4)}
+
+    def score(c):
+        return sum((v - 1.0) ** 2 for v in c.values())
+
+    budget = 120
+    res_g = LocalOptimizationRunner(
+        GeneticSearchCandidateGenerator(spaces, population=12, seed=5),
+        score, [MaxCandidatesCondition(budget)]).execute()
+    res_r = LocalOptimizationRunner(
+        RandomSearchGenerator(spaces, seed=5),
+        score, [MaxCandidatesCondition(budget)]).execute()
+    assert res_g.best_score <= res_r.best_score * 1.5  # GA at least competitive
+    assert res_g.best_score < 1.0
+
+
+def test_multilayer_space_search():
+    """End-to-end: search layer width + lr on a tiny classification task."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(96, 6).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[np.argmax(X[:, :3], 1)]
+
+    mls = (MultiLayerSpace.Builder()
+           .seed(7)
+           .learning_rate(ContinuousParameterSpace(1e-3, 1e-1, log_scale=True))
+           .add_layer(LayerSpace(DenseLayer, n_in=6,
+                                 n_out=IntegerParameterSpace(4, 24),
+                                 activation="tanh"))
+           .add_layer(LayerSpace(OutputLayer, n_out=3, activation="softmax",
+                                 loss="mcxent"))
+           .build())
+    spaces = mls.param_spaces()
+    assert set(spaces) == {"learning_rate", "layer0.n_out"}
+
+    def score(candidate):
+        net = MultiLayerNetwork(mls.materialize(candidate)).init()
+        for _ in range(8):
+            net._fit_batch(DataSet(X, Y))
+        return net.score_
+
+    res = LocalOptimizationRunner(
+        RandomSearchGenerator(spaces, seed=1), score,
+        [MaxCandidatesCondition(5)]).execute()
+    assert np.isfinite(res.best_score)
+    assert 4 <= res.best_candidate["layer0.n_out"] <= 24
+    assert len(res.all_results) == 5

@@ -1,0 +1,32 @@
+"""RL (DQN) tests on a deterministic toy MDP (SURVEY §2.7 R1)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import ExpReplay, QLearningConfiguration, QLearningDiscrete
+from deeplearning4j_tpu.rl.mdp import SimpleToyMDP
+
+
+def test_exp_replay_ring_buffer():
+    rep = ExpReplay(max_size=4, batch_size=2, seed=0)
+    for i in range(6):
+        rep.store(np.array([i]), i % 2, float(i), np.array([i + 1]), False)
+    assert len(rep) == 4  # ring evicted oldest
+    s, a, r, s2, d = rep.sample()
+    assert s.shape == (2, 1) and r.min() >= 2.0  # entries 0,1 evicted
+
+
+def test_dqn_learns_chain_mdp():
+    mdp = SimpleToyMDP(n=5, max_steps=30)
+    cfg = QLearningConfiguration(
+        seed=3, max_step=2500, batch_size=32, update_start=64,
+        target_dqn_update_freq=100, eps_anneal_steps=1200, min_epsilon=0.05,
+        gamma=0.95, max_epoch_step=30)
+    learner = QLearningDiscrete(mdp, cfg, hidden=32)
+    learner.train()
+    policy = learner.get_policy()
+    # greedy policy must walk straight to the goal: 4 steps, reward ~ +10
+    total = policy.play(SimpleToyMDP(n=5, max_steps=30))
+    assert total > 9.0, total
+    # epsilon annealed
+    assert abs(learner.epsilon() - cfg.min_epsilon) < 1e-6

@@ -1,0 +1,112 @@
+"""UI stats pipeline + JSON serving tests (SURVEY §2.4 C14, §2.6 S7, §5.1)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.serving import JsonModelClient, JsonModelServer
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    ProfilingListener,
+    StatsListener,
+    UIServer,
+)
+from deeplearning4j_tpu.ui.profiling import ProfileAnalyzer
+
+
+def _net():
+    conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _fit(net, listeners, steps=12):
+    net.add_listeners(*listeners)
+    rs = np.random.RandomState(0)
+    X = rs.randn(16, 4).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+    for _ in range(steps):
+        net._fit_batch(DataSet(X, Y))
+
+
+def test_stats_listener_records():
+    storage = InMemoryStatsStorage()
+    net = _net()
+    _fit(net, [StatsListener(storage, frequency=2)])
+    recs = storage.records()
+    assert len(recs) == 6
+    r = recs[-1]
+    assert "score" in r and "params" in r and "update_ratios" in r
+    assert "0/W" in r["params"] and r["params"]["0/W"]["std"] > 0
+    assert r["update_ratios"]["1/W"] > 0  # params actually moving
+
+
+def test_file_stats_storage_roundtrip(tmp_path):
+    p = str(tmp_path / "stats.jsonl")
+    storage = FileStatsStorage(p)
+    storage.put_record({"session": "s1", "iteration": 1, "score": 0.5})
+    storage.put_record({"session": "s2", "iteration": 2, "score": 0.4})
+    assert len(storage.records()) == 2
+    assert storage.records("s1")[0]["score"] == 0.5
+    assert storage.session_ids() == ["s1", "s2"]
+
+
+def test_ui_server_endpoints():
+    storage = InMemoryStatsStorage()
+    net = _net()
+    _fit(net, [StatsListener(storage, frequency=1)])
+    server = UIServer(port=0)
+    server.attach(storage)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/data", timeout=10) as r:
+            d = json.loads(r.read())
+        assert d["records"] == 12 and len(d["score"]) == 12
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            assert b"Training overview" in r.read()
+    finally:
+        server.stop()
+
+
+def test_profiling_listener_and_analyzer(tmp_path):
+    p = str(tmp_path / "trace.json")
+    net = _net()
+    lst = ProfilingListener(p)
+    _fit(net, [lst], steps=6)
+    lst.flush()
+    trace = ProfileAnalyzer.load(p)
+    assert len(trace["traceEvents"]) == 5  # N steps -> N-1 complete events
+    s = ProfileAnalyzer.summarize(trace)
+    assert s["events"] == 5 and s["mean_us"] > 0
+    cmp = ProfileAnalyzer.compare(trace, trace)
+    assert abs(cmp["mean_speedup"] - 1.0) < 1e-9
+
+
+def test_json_model_server_roundtrip():
+    net = _net()
+    server = JsonModelServer.Builder(net).port(0).build().start()
+    try:
+        client = JsonModelClient(port=server.port)
+        x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        out = np.asarray(client.predict(x))
+        ref = net.output(x).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+        # malformed input -> HTTP error, server stays alive
+        try:
+            client.predict(["not", "numbers"])
+            raised = False
+        except Exception:
+            raised = True
+        assert raised
+        out2 = np.asarray(client.predict(x))
+        np.testing.assert_allclose(out2, ref, atol=1e-5)
+    finally:
+        server.stop()
