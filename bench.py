@@ -1,40 +1,65 @@
-"""Benchmark runner — prints ONE JSON line.
+"""Benchmark runner — prints ONE JSON line covering all 5 BASELINE configs.
 
-Headline metric (BASELINE.json): ResNet-50 images/sec/chip. The whole
-train step (forward+backward+updater) is one compiled XLA executable; the
-loop below keeps dispatch async and only syncs at the end.
+Headline metric (BASELINE.json): ResNet-50 images/sec/chip. The other four
+configs (LeNet MNIST TTA, GravesLSTM chars/sec, Word2Vec words/sec, BERT
+tokens/sec) ride in the ``configs`` key of the same line.
+
+Every train step is ONE compiled XLA executable; the loops below keep
+dispatch async and sync once at the end. The mixed-precision policy
+(TDL_MATMUL_PRECISION; see deeplearning4j_tpu/common/precision.py) is
+recorded alongside each number per BASELINE.md's measurement protocol.
 
 No reference numbers exist to compare against (BASELINE.json "published" is
-empty; see BASELINE.md provenance note), so vs_baseline is reported as the
-ratio against the value recorded in BENCH_BASELINE.json once a previous
-round has produced one (self-relative trend), else 1.0.
+empty), so vs_baseline is the ratio against this repo's own previous round,
+read from the per-backend BENCH_BASELINE.<backend>.json (legacy
+BENCH_BASELINE.json honored when its backend matches — never overwritten by
+a different backend's run; ADVICE r1).
 """
 
 from __future__ import annotations
 
 import json
-import os
 import pathlib
+import sys
 import time
 
 import numpy as np
 
+_HERE = pathlib.Path(__file__).parent
 
-def main():
+
+# --------------------------------------------------------------------- config
+
+
+def _scale(on_tpu):
+    """(resnet, lenet, lstm, w2v, bert) shape params; small on CPU smoke."""
+    if on_tpu:
+        return {
+            "resnet50": dict(batch=256, hw=224, classes=1000, steps=20, warmup=3),
+            "lenet": dict(batch=128, examples=12800, target_acc=0.95, max_epochs=12),
+            "lstm": dict(batch=64, vocab=77, seqlen=200, tbptt=50, steps=10, warmup=2),
+            "w2v": dict(sent=4000, layer=100),
+            "bert": dict(batch=16, seq=128, steps=10, warmup=2, tiny=False),
+        }
+    return {
+        "resnet50": dict(batch=8, hw=64, classes=10, steps=5, warmup=2),
+        "lenet": dict(batch=64, examples=1280, target_acc=0.90, max_epochs=6),
+        "lstm": dict(batch=8, vocab=32, seqlen=100, tbptt=50, steps=3, warmup=1),
+        "w2v": dict(sent=400, layer=32),
+        "bert": dict(batch=2, seq=64, steps=3, warmup=1, tiny=True),
+    }
+
+
+# ------------------------------------------------------------------ resnet-50
+
+
+def bench_resnet50(p):
     import jax
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.models import ResNet50
 
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
-    # full ImageNet-shape config on TPU; reduced config for CPU smoke runs
-    if on_tpu:
-        batch, hw, classes, steps, warmup = 128, 224, 1000, 20, 3
-    else:
-        batch, hw, classes, steps, warmup = 8, 64, 10, 5, 2
-
+    batch, hw, classes = p["batch"], p["hw"], p["classes"]
     net = ResNet50(num_classes=classes, input_shape=(3, hw, hw)).init()
     step = net._train_step_fn()
 
@@ -46,46 +71,197 @@ def main():
     ep = jnp.asarray(0, jnp.int32)
 
     params, opt, bn = net.params_, net.updater_state, net.bn_state
-    for i in range(warmup):
+    for _ in range(p["warmup"]):
         params, opt, bn, loss = step(params, opt, bn, it, ep, x, y, None, rng)
-    float(loss)  # device fetch = true sync (block_until_ready alone does not
-    # drain the axon tunnel's async dispatch queue)
+    float(loss)  # device fetch = true sync (drains the axon tunnel queue)
 
     t0 = time.perf_counter()
-    for i in range(steps):
+    for _ in range(p["steps"]):
         params, opt, bn, loss = step(params, opt, bn, it, ep, x, y, None, rng)
     float(loss)
     dt = time.perf_counter() - t0
+    return {"metric": "resnet50_train_images_per_sec",
+            "value": round(batch * p["steps"] / dt, 2),
+            "unit": "images/sec/chip", "batch": batch, "image_size": hw}
 
-    images_per_sec = batch * steps / dt
 
-    baseline_file = pathlib.Path(__file__).parent / "BENCH_BASELINE.json"
-    vs = 1.0
+# --------------------------------------------------------------- lenet (TTA)
+
+
+def bench_lenet(p):
+    from deeplearning4j_tpu.data.datasets import MnistDataSetIterator
+    from deeplearning4j_tpu.models import LeNet
+
+    net = LeNet(num_classes=10).init()
+    train_it = MnistDataSetIterator(p["batch"], train=True, num_examples=p["examples"])
+    test_it = MnistDataSetIterator(256, train=False, num_examples=min(2560, p["examples"]))
+
+    t0 = time.perf_counter()
+    tta = None
+    images = 0
+    for epoch in range(p["max_epochs"]):
+        train_it.reset()
+        for ds in train_it:
+            net.fit(ds)
+            images += ds.features.shape[0]
+        test_it.reset()
+        acc = net.evaluate(test_it).accuracy()
+        if acc >= p["target_acc"]:
+            tta = time.perf_counter() - t0
+            break
+    total = time.perf_counter() - t0
+    return {"metric": "lenet_mnist_time_to_accuracy",
+            "value": round(tta, 2) if tta is not None else None,  # null = not reached (valid JSON)
+            "unit": f"sec_to_{p['target_acc']:.0%}_acc",
+            "reached": tta is not None, "final_acc": round(float(acc), 4),
+            "images_per_sec": round(images / total, 1)}
+
+
+# -------------------------------------------------------- graveslstm char-rnn
+
+
+def bench_lstm(p):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models import TextGenerationLSTM
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    B, V, T = p["batch"], p["vocab"], p["seqlen"]
+    net = MultiLayerNetwork(TextGenerationLSTM(vocab_size=V, tbptt_length=p["tbptt"]).conf()).init()
+    rs = np.random.RandomState(0)
+    idx = rs.randint(0, V, (B, T))
+    x = np.eye(V, dtype=np.float32)[idx].transpose(0, 2, 1)  # [B,V,T]
+    y = np.eye(V, dtype=np.float32)[np.roll(idx, -1, 1)].transpose(0, 2, 1)
+    ds = DataSet(x, y)
+
+    for _ in range(p["warmup"]):
+        net.fit(ds)
+    t0 = time.perf_counter()
+    for _ in range(p["steps"]):
+        net.fit(ds)
+    dt = time.perf_counter() - t0
+    return {"metric": "graveslstm_chars_per_sec",
+            "value": round(B * T * p["steps"] / dt, 1),
+            "unit": "chars/sec", "batch": B, "seqlen": T, "tbptt": p["tbptt"]}
+
+
+# ------------------------------------------------------------------- word2vec
+
+
+def bench_w2v(p):
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    rs = np.random.RandomState(0)
+    vocab = [f"w{i}" for i in range(2000)]
+    zipf = 1.0 / np.arange(1, len(vocab) + 1)
+    zipf /= zipf.sum()
+    sentences = [" ".join(rs.choice(vocab, size=rs.randint(8, 20), p=zipf))
+                 for _ in range(p["sent"])]
+    total_words = sum(len(s.split()) for s in sentences)
+
+    w2v = Word2Vec(layer_size=p["layer"], window=5, negative=5, epochs=1, batch_size=1024)
+    t0 = time.perf_counter()
+    w2v.fit(sentences)
+    dt = time.perf_counter() - t0
+    return {"metric": "word2vec_words_per_sec",
+            "value": round(total_words / dt, 1), "unit": "words/sec",
+            "corpus_words": total_words, "layer_size": p["layer"]}
+
+
+# ----------------------------------------------------------------- bert mlm
+
+
+def bench_bert(p):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import TransformerConfig, init_params, make_train_step
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    B, T = p["batch"], p["seq"]
+    cfg = (TransformerConfig.tiny(dropout=0.0) if p["tiny"]
+           else TransformerConfig.bert_base(max_len=T, dropout=0.0))
+    params = init_params(jax.random.key(0), cfg)
+    updater = Adam(1e-4)
+    opt = updater.init(params)
+    step = jax.jit(make_train_step(cfg, updater), donate_argnums=(0, 1))
+
+    rs = np.random.RandomState(0)
+    batch = {
+        "tokens": jnp.asarray(rs.randint(0, cfg.vocab_size, (B, T)), jnp.int32),
+        "labels": jnp.asarray(rs.randint(0, cfg.vocab_size, (B, T)), jnp.int32),
+        "weights": jnp.asarray((rs.rand(B, T) < 0.15).astype(np.float32)),
+    }
+    rng = jax.random.key(1)
+    it = jnp.asarray(0, jnp.int32)
+    for _ in range(p["warmup"]):
+        params, opt, loss = step(params, opt, batch, it, rng)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(p["steps"]):
+        params, opt, loss = step(params, opt, batch, it, rng)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return {"metric": "bert_mlm_tokens_per_sec",
+            "value": round(B * T * p["steps"] / dt, 1), "unit": "tokens/sec/chip",
+            "batch": B, "seq": T,
+            "model": "tiny" if p["tiny"] else "bert-base"}
+
+
+# --------------------------------------------------------------------- driver
+
+
+def _baseline_ratio(backend, value):
+    """Per-backend self-relative trend (ADVICE r1: never cross-compare or
+    clobber another backend's baseline)."""
+    per = _HERE / f"BENCH_BASELINE.{backend}.json"
+    legacy = _HERE / "BENCH_BASELINE.json"
     prev = None
-    if baseline_file.exists():
-        try:
-            d = json.loads(baseline_file.read_text())
-            if d.get("backend") == backend:
-                prev = d.get("value")
-        except Exception:
-            pass
+    for f in (per, legacy):
+        if f.exists():
+            try:
+                d = json.loads(f.read_text())
+                if d.get("backend") == backend:
+                    prev = d.get("value")
+                    break
+            except Exception:
+                pass
     if prev:
-        vs = images_per_sec / prev
-    else:
-        baseline_file.write_text(json.dumps(
-            {"metric": "resnet50_train_images_per_sec", "value": images_per_sec,
-             "backend": backend, "batch": batch, "image": hw}))
+        return value / prev
+    per.write_text(json.dumps({"metric": "resnet50_train_images_per_sec",
+                               "value": value, "backend": backend}))
+    return 1.0
 
-    print(json.dumps({
-        "metric": "resnet50_train_images_per_sec",
-        "value": round(images_per_sec, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(vs, 3),
+
+BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
+           "w2v": bench_w2v, "bert": bench_bert}
+
+
+def main():
+    import jax
+
+    from deeplearning4j_tpu.common.environment import env
+
+    backend = jax.default_backend()
+    params = _scale(backend == "tpu")
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    if only and only not in BENCHES:
+        sys.exit(f"unknown benchmark {only!r}; choose from: {', '.join(BENCHES)}")
+    names = [only] if only else list(BENCHES)
+
+    results = {name: BENCHES[name](params[name]) for name in names}
+
+    head = results.get("resnet50") or results[names[0]]
+    out = {
+        "metric": head["metric"],
+        "value": head["value"],
+        "unit": head["unit"],
+        "vs_baseline": round(_baseline_ratio(backend, head["value"]), 3)
+        if head["metric"] == "resnet50_train_images_per_sec" else 1.0,
         "backend": backend,
-        "batch": batch,
-        "image_size": hw,
-        "num_classes": classes,
-    }))
+        "matmul_precision": env().matmul_precision,
+        "configs": results,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -496,7 +496,8 @@ class SameDiff:
                            tuple(vd["shape"]) if vd["shape"] else None)
             sd.vars[vd["name"]] = v
         for n in graph["ops"]:
-            sd.ops.append(OpNode(n["op"], n["inputs"], n["outputs"], n["kwargs"], n["n_outputs"]))
+            sd.ops.append(OpNode(n["op"], n["inputs"], n["outputs"],
+                                 _json_decode(n["kwargs"]), n["n_outputs"]))
         sd.arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
         sd.loss_names = graph.get("loss", [])
         sd.iteration_count = graph.get("iteration_count", 0)
@@ -561,17 +562,39 @@ class TrainingConfig:
 # ------------------------------------------------------------------ helpers
 
 
-def _json_safe(d):
-    out = {}
-    for k, v in d.items():
-        if isinstance(v, (np.integer,)):
-            v = int(v)
-        elif isinstance(v, (np.floating,)):
-            v = float(v)
-        elif isinstance(v, tuple):
-            v = list(v)
-        out[k] = v
-    return out
+def _json_safe(v):
+    """Recursive JSON coercion for op kwargs (ADVICE r1: top-level-only
+    conversion made save() raise on nested numpy values / dtype objects).
+    Dtypes serialize as ``{"__dtype__": "float32"}``; ``_json_decode``
+    restores them on load."""
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+    if isinstance(v, (np.dtype, jnp.dtype)) or (isinstance(v, type) and issubclass(v, np.generic)):
+        return {"__dtype__": str(np.dtype(v))}
+    if hasattr(v, "dtype") and hasattr(v, "shape"):  # jax array leaf
+        a = np.asarray(v)
+        return {"__ndarray__": a.tolist(), "dtype": str(a.dtype)}
+    return v
+
+
+def _json_decode(v):
+    if isinstance(v, dict):
+        if "__dtype__" in v and len(v) == 1:
+            return np.dtype(v["__dtype__"])
+        if "__ndarray__" in v:
+            return np.asarray(v["__ndarray__"], dtype=v.get("dtype"))
+        return {k: _json_decode(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_json_decode(x) for x in v]
+    return v
 
 
 def _npz_bytes(d):

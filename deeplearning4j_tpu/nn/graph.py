@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common.dtypes import to_jax
+from ..common.precision import amp_enabled, cast_floating, cast_input, compute_dtype
 from ..data.dataset import DataSet, MultiDataSet
 from ..eval.evaluation import Evaluation
 from ..ndarray.ndarray import NDArray
@@ -119,8 +120,13 @@ class ComputationGraph:
     # ------------------------------------------------------------------- fit
 
     def _train_step_fn(self):
-        if "train" in self._jit_cache:
-            return self._jit_cache["train"]
+        # AMP: bf16 compute off cast-on-entry params, fp32 masters/grads/loss
+        # (see common/precision.py); cache keyed on the resolved policy
+        amp = amp_enabled(self._dtype)
+        cdt = compute_dtype()
+        cache_key = ("train", amp)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
         updater = self.conf.updater
         gn, gnt = self.conf.gradient_normalization, self.conf.gradient_normalization_threshold
 
@@ -129,7 +135,9 @@ class ComputationGraph:
 
         def step(params, upd_state, bn_state, iteration, epoch, inputs, labels, lmasks, rng):
             def loss_fn(p):
-                return self._forward(p, bn_state, inputs, training=True, rng=rng, labels=labels, lmasks=lmasks)
+                pc = cast_floating(p, cdt) if amp else p
+                xc = {k: cast_input(v, cdt) for k, v in inputs.items()} if amp else inputs
+                return self._forward(pc, bn_state, xc, training=True, rng=rng, labels=labels, lmasks=lmasks)
 
             (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = _mask_frozen(grads, frozen)
@@ -138,8 +146,8 @@ class ComputationGraph:
             new_params = jax.tree.map(lambda p, u: p - u, params, updates)
             return new_params, new_upd, new_bn, loss
 
-        self._jit_cache["train"] = jax.jit(step, donate_argnums=(0, 1, 2))
-        return self._jit_cache["train"]
+        self._jit_cache[cache_key] = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._jit_cache[cache_key]
 
     def _coerce_inputs(self, features) -> Dict[str, jnp.ndarray]:
         if isinstance(features, dict):

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common.dtypes import to_jax
+from ..common.precision import amp_enabled, cast_floating, cast_input, compute_dtype
 from ..data.dataset import DataSet
 from ..data.iterators import ArrayDataSetIterator, DataSetIterator, ListDataSetIterator
 from ..eval.evaluation import Evaluation, RegressionEvaluation
@@ -186,17 +187,27 @@ class MultiLayerNetwork:
     def _train_step_fn(self):
         """Build/jit-cache THE train step: grads+updater+apply in one XLA
         program with donated state (§3.2 'TPU equivalent' note)."""
-        if "train" in self._jit_cache:
-            return self._jit_cache["train"]
+        # AMP (TDL_MATMUL_PRECISION=bfloat16): forward/backward in bf16 off a
+        # cast-on-entry copy; masters/grads/updater stay fp32 (the entry cast's
+        # transpose re-accumulates grads in fp32). Cache keyed on the resolved
+        # policy so env().set("matmul_precision", ...) mid-run takes effect.
+        amp = amp_enabled(self._dtype)
+        cdt = compute_dtype()
+        cache_key = ("train", amp)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
         updater = self.conf.updater
         gn, gnt = self.conf.gradient_normalization, self.conf.gradient_normalization_threshold
 
         frozen = {str(i) for i, l in enumerate(self.conf.layers) if l.frozen}
 
         def step(params, upd_state, bn_state, iteration, epoch, x, y, fmask, lmask, rng):
-            (loss, (new_bn, _)), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
-                params, bn_state, x, y, fmask, lmask, rng, True
-            )
+            def lossf(p):
+                pc = cast_floating(p, cdt) if amp else p
+                xc = cast_input(x, cdt) if amp else x
+                return self._loss_fn(pc, bn_state, xc, y, fmask, lmask, rng, True)
+
+            (loss, (new_bn, _)), grads = jax.value_and_grad(lossf, has_aux=True)(params)
             grads = _mask_frozen(grads, frozen)
             grads = _grad_normalize(grads, gn, gnt)
             updates, new_upd = updater.apply(grads, upd_state, params, iteration, epoch)
@@ -204,19 +215,24 @@ class MultiLayerNetwork:
             return new_params, new_upd, new_bn, loss
 
         jitted = jax.jit(step, donate_argnums=(0, 1, 2))
-        self._jit_cache["train"] = jitted
+        self._jit_cache[cache_key] = jitted
         return jitted
 
     def _tbptt_step_fn(self):
-        if "tbptt" in self._jit_cache:
-            return self._jit_cache["tbptt"]
+        amp = amp_enabled(self._dtype)
+        cdt = compute_dtype()
+        cache_key = ("tbptt", amp)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
         updater = self.conf.updater
         gn, gnt = self.conf.gradient_normalization, self.conf.gradient_normalization_threshold
         frozen = {str(i) for i, l in enumerate(self.conf.layers) if l.frozen}
 
         def step(params, upd_state, bn_state, rnn_states, iteration, epoch, x, y, fmask, lmask, rng):
             def loss_with_states(p):
-                return self._loss_fn(p, bn_state, x, y, fmask, lmask, rng, True, rnn_states)
+                pc = cast_floating(p, cdt) if amp else p
+                xc = cast_input(x, cdt) if amp else x
+                return self._loss_fn(pc, bn_state, xc, y, fmask, lmask, rng, True, rnn_states)
 
             (loss, (new_bn, new_rnn)), grads = jax.value_and_grad(loss_with_states, has_aux=True)(params)
             grads = _mask_frozen(grads, frozen)
@@ -228,7 +244,7 @@ class MultiLayerNetwork:
             return new_params, new_upd, new_bn, new_rnn, loss
 
         jitted = jax.jit(step, donate_argnums=(0, 1, 2, 3))
-        self._jit_cache["tbptt"] = jitted
+        self._jit_cache[cache_key] = jitted
         return jitted
 
     # ------------------------------------------------------------------- fit

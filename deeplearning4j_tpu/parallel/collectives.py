@@ -94,8 +94,14 @@ class FakeCollectives(Collectives):
         self.timeout = timeout
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._slots: Dict[str, Dict[int, Any]] = {}
-        self._complete: set = set()  # latched: names whose rendezvous finished
+        # slots keyed by (name, generation): the Nth time a rank joins "name"
+        # it enters generation N, so reusing a name (barrier("sync") once per
+        # step) synchronizes every round instead of replaying round 0
+        # (ADVICE r1). Fully-retrieved generations are garbage-collected.
+        self._slots: Dict[Any, Dict[int, Any]] = {}
+        self._complete: set = set()  # latched: (name, gen) whose rendezvous finished
+        self._joins: Dict[Any, int] = {}  # (name, rank) -> generations entered
+        self._retrieved: Dict[Any, int] = {}  # (name, gen) -> ranks done
         self._delays: Dict[int, float] = {}
         self._failed: set = set()
 
@@ -108,8 +114,8 @@ class FakeCollectives(Collectives):
             # invalidate the dead rank's deposits: any collective it hadn't
             # fully completed must abort for the survivors (already-returned
             # collectives handed out copies and are unaffected)
-            for name, slot in self._slots.items():
-                if name not in self._complete:
+            for key, slot in self._slots.items():
+                if key not in self._complete:
                     slot.pop(rank, None)
             self._cond.notify_all()
 
@@ -125,16 +131,26 @@ class FakeCollectives(Collectives):
         with self._cond:
             if rank in self._failed:
                 raise TransportError(f"rank {rank} is failed")
-            slot = self._slots.setdefault(name, {})
+            gen = self._joins.get((name, rank), 0)
+            self._joins[(name, rank)] = gen + 1
+            key = (name, gen)
+            slot = self._slots.setdefault(key, {})
             slot[rank] = value
             self._cond.notify_all()
             while True:
                 # completeness first (and latched): a failure injected after
                 # every rank deposited must not abort the finished collective,
                 # even for ranks that have not woken yet
-                if name in self._complete or set(range(self.world_size)).issubset(slot.keys()):
-                    self._complete.add(name)
-                    return dict(slot)
+                if key in self._complete or set(range(self.world_size)).issubset(slot.keys()):
+                    self._complete.add(key)
+                    out = dict(slot)
+                    done = self._retrieved.get(key, 0) + 1
+                    self._retrieved[key] = done
+                    if done >= self.world_size:  # all ranks served: GC the slot
+                        self._slots.pop(key, None)
+                        self._complete.discard(key)
+                        self._retrieved.pop(key, None)
+                    return out
                 if self._failed:
                     # gang-scheduled semantics: any failed member aborts the
                     # collective for EVERY rank (whole-step abort → restore)

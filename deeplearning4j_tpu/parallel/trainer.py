@@ -102,10 +102,16 @@ class ParallelTrainer:
             rng = jax.random.fold_in(jax.random.key(n.conf.seed ^ 0x5EED), n.iteration)
             inputs = {k: self._shard(v) for k, v in n._coerce_inputs([ds.features]).items()}
             labels = {k: self._shard(v) for k, v in n._coerce_labels([ds.labels]).items()}
+            # same lmasks shape the single-device path builds (ADVICE r1:
+            # dropping the mask silently changed masked-sequence losses)
+            lmasks = (
+                {n.conf.network_outputs[0]: self._shard(jnp.asarray(ds.labels_mask))}
+                if ds.labels_mask is not None else None
+            )
             n.params_, n.updater_state, n.bn_state, loss = step(
                 n.params_, n.updater_state, n.bn_state,
                 jnp.asarray(n.iteration, jnp.int32), jnp.asarray(n.epoch, jnp.int32),
-                inputs, labels, None, rng)
+                inputs, labels, lmasks, rng)
             n.score_ = float(loss)
             n.iteration += 1
             for lst in n.listeners:

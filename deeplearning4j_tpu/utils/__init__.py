@@ -62,8 +62,11 @@ def set_learning_rate(model, lr: float) -> None:
     """NetworkUtils.setLearningRate: adjust the updater lr mid-training."""
     if hasattr(model.conf.updater, "learning_rate"):
         model.conf.updater.learning_rate = lr
-    model._jit_cache.pop("train", None)
-    model._jit_cache.pop("tbptt", None)
+    # train/tbptt steps bake the updater in; drop every cached variant
+    # (keys are ("train", amp) / ("tbptt", amp) tuples)
+    for k in [k for k in model._jit_cache
+              if isinstance(k, tuple) and k[0] in ("train", "tbptt")]:
+        model._jit_cache.pop(k, None)
 
 
 def get_learning_rate(model) -> Optional[float]:

@@ -93,25 +93,35 @@ int32_t tnd_csv_parse_f32(const char* data, int64_t len, char delimiter,
   }
   bool in_row = false;
   while (i < len) {
-    // parse one field with strtof (handles +-, exponents, inf/nan)
+    // handle whitespace BEFORE strtof: strtof treats '\n' as skippable
+    // leading whitespace, which would silently merge a row ending in a
+    // trailing delimiter with the next row (ADVICE r1, medium)
+    const char c = data[i];
+    if (c == '\r' || c == ' ' || c == '\t') {
+      ++i;
+      continue;
+    }
+    if (c == '\n') {
+      if (in_row) return -1;  // trailing delimiter -> empty field expected
+      ++i;                    // blank line between rows
+      continue;
+    }
+    // parse one field with strtof (handles +-, exponents, inf/nan); it can
+    // no longer see a leading newline, so it stays within the current row
     const char* start = data + i;
     char* end = nullptr;
     const float v = std::strtof(start, &end);
     if (end == start) {
-      // empty field or garbage; skip bare newlines, reject real garbage
-      if (data[i] == '\n' || data[i] == '\r') {
-        ++i;
-        continue;
-      }
-      return -1;
+      return -1;  // empty field or garbage
     }
     if (vals >= max_vals) return -2;
     out[vals++] = v;
     ++col_in_row;
     in_row = true;
     i = end - data;
-    // consume delimiter or end-of-line
-    while (i < len && data[i] == '\r') ++i;
+    // consume delimiter or end-of-line (trailing spaces/tabs are padding,
+    // not an empty field)
+    while (i < len && (data[i] == '\r' || data[i] == ' ' || data[i] == '\t')) ++i;
     if (i < len && data[i] == delimiter) {
       ++i;
     } else if (i >= len || data[i] == '\n') {

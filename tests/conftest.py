@@ -13,6 +13,9 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("TDL_DEFAULT_FLOAT", "float32")
+# numerics tests (grad checks, parity-to-1e-6 assertions) run the fp32 policy;
+# the bf16 AMP path has its own dedicated tests (tests/test_precision.py)
+os.environ.setdefault("TDL_MATMUL_PRECISION", "float32")
 
 # The axon sitecustomize has ALREADY imported jax and registered the real-TPU
 # tunnel plugin at interpreter startup, with JAX_PLATFORMS=axon captured into

@@ -50,3 +50,26 @@ def test_native_csv_parse(tmp_path):
     p2 = tmp_path / "bad.csv"
     p2.write_text("x,y\nfoo,bar\n")
     assert load_csv_f32(str(p2), skip_rows=1) is None
+
+
+def test_native_csv_trailing_delimiter_rejected(tmp_path):
+    """ADVICE r1 (medium): a trailing delimiter must NOT merge rows —
+    strtof used to eat the newline as leading whitespace, so
+    "1,2,\\n3,4,\\n" silently parsed as one 1x4 row."""
+    from deeplearning4j_tpu.data.records import load_csv_f32
+
+    p = tmp_path / "trail.csv"
+    p.write_text("1,2,\n3,4,\n")
+    assert load_csv_f32(str(p)) is None  # empty trailing field = error
+
+    # trailing spaces/tabs before EOL are padding, not an empty field
+    p2 = tmp_path / "pad.csv"
+    p2.write_text("1.0,2.0 \n3.0,4.0\t\n")
+    arr = load_csv_f32(str(p2))
+    np.testing.assert_allclose(arr, [[1.0, 2.0], [3.0, 4.0]])
+
+    # blank lines between rows are skipped
+    p3 = tmp_path / "blank.csv"
+    p3.write_text("1,2\n\n3,4\n")
+    arr = load_csv_f32(str(p3))
+    np.testing.assert_allclose(arr, [[1, 2], [3, 4]])
