@@ -48,7 +48,7 @@ def mha_reference(q, k, v, mask=None, *, causal: bool = False, scale: Optional[f
 # --------------------------------------------------------------------- flash
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, causal, block_q, block_k, num_k, q_offset):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *, scale, causal, block_q, block_k, num_k, q_offset):
     """One (q-block, k-block) grid step of online-softmax flash attention.
 
     TPU grid iterates the LAST axis sequentially, so scratch (m/l/acc)
@@ -88,19 +88,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, c
     @pl.when(kb == num_k - 1)
     def _fin():
         o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
 
 
-def flash_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128, interpret: Optional[bool] = None):
-    """Pallas flash attention, O(T) memory (blockwise online softmax).
-
-    Falls back to interpret mode off-TPU so the same code path is testable on
-    the CPU mesh (SURVEY §4.6 #4: fast-path vs reference-path parity harness).
-    """
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     block_q = min(block_q, Tq)
@@ -116,7 +107,7 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = N
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_k=num_k, q_offset=Tk - Tq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // block_q, num_k),
         in_specs=[
@@ -124,8 +115,14 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = N
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -133,7 +130,61 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = N
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(B, H, Tq, D)
+    return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qf, kf, vf, dof = (t.astype(jnp.float32) for t in (q, k, v, do))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        qpos = jnp.arange(Tq)[:, None] + (Tk - Tq)
+        s = jnp.where(qpos >= jnp.arange(Tk)[None, :], s, _NEG_INF)
+    p = jnp.exp(s - lse)                                   # exact probs from saved lse
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128, interpret: Optional[bool] = None):
+    """Pallas flash attention, O(T) forward memory (blockwise online softmax).
+
+    Differentiable via custom_vjp: the forward kernel also emits the per-row
+    logsumexp; the backward pass reconstructs exact softmax probabilities
+    ``p = exp(s - lse)`` and forms dQ/dK/dV with dense einsums (the standard
+    FlashAttention backward identities, XLA-fused; a blockwise Pallas
+    backward is a further optimization, not a correctness need).
+
+    Falls back to interpret mode off-TPU so the same code path is testable on
+    the CPU mesh (SURVEY §4.6 #4: fast-path vs reference-path parity harness).
+    """
+    return _flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 # ---------------------------------------------------------------------- ring

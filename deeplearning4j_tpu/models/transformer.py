@@ -44,6 +44,8 @@ class TransformerConfig:
     attn_impl: str = "auto"          # auto | xla | flash | ring
     sequence_axis: Optional[str] = None  # mesh axis for ring attention ("sp")
     remat: bool = False              # jax.checkpoint each block (HBM for FLOPs)
+    norm_position: str = "pre"       # "pre" (GPT-style, default) | "post" (original BERT)
+    gelu_approximate: bool = True    # False = erf gelu (HF BERT parity)
 
     @property
     def head_dim(self) -> int:
@@ -183,23 +185,36 @@ def _block(cfg: TransformerConfig, p, h, pad_mask, rng, train):
     B, T, D = h.shape
     H, hd = cfg.n_heads, cfg.head_dim
     cd = cfg.compute_dtype
+    pre = cfg.norm_position == "pre"
 
-    x = _layer_norm(h, p["ln1_scale"], p["ln1_bias"]).astype(cd)
-    qkv = x @ p["qkv_w"].astype(cd) + p["qkv_b"].astype(cd)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    # [B,T,D] -> [B,H,T,hd]
-    q, k, v = (t.reshape(B, T, H, hd).transpose(0, 2, 1, 3) for t in (q, k, v))
-    o = _attention(cfg, q, k, v, pad_mask)
-    o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
-    o = o @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
-    o = _dropout(o, cfg, rng, 0, train)
-    h = h + o.astype(h.dtype)
+    def gelu(x):
+        return jax.nn.gelu(x, approximate=cfg.gelu_approximate)
 
-    x = _layer_norm(h, p["ln2_scale"], p["ln2_bias"]).astype(cd)
-    x = jax.nn.gelu(x @ p["ffn_w1"].astype(cd) + p["ffn_b1"].astype(cd))
-    x = x @ p["ffn_w2"].astype(cd) + p["ffn_b2"].astype(cd)
-    x = _dropout(x, cfg, rng, 1, train)
-    return h + x.astype(h.dtype)
+    def attn_sub(x):
+        qkv = x @ p["qkv_w"].astype(cd) + p["qkv_b"].astype(cd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # [B,T,D] -> [B,H,T,hd]
+        q, k, v = (t.reshape(B, T, H, hd).transpose(0, 2, 1, 3) for t in (q, k, v))
+        o = _attention(cfg, q, k, v, pad_mask)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
+        o = o @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
+        return _dropout(o, cfg, rng, 0, train)
+
+    def ffn_sub(x):
+        x = gelu(x @ p["ffn_w1"].astype(cd) + p["ffn_b1"].astype(cd))
+        x = x @ p["ffn_w2"].astype(cd) + p["ffn_b2"].astype(cd)
+        return _dropout(x, cfg, rng, 1, train)
+
+    if pre:  # GPT-style pre-LN: h + f(LN(h))
+        h = h + attn_sub(_layer_norm(h, p["ln1_scale"], p["ln1_bias"]).astype(cd)).astype(h.dtype)
+        h = h + ffn_sub(_layer_norm(h, p["ln2_scale"], p["ln2_bias"]).astype(cd)).astype(h.dtype)
+        return h
+    # original-BERT post-LN: LN(h + f(h))  (required for faithful HF import)
+    h = _layer_norm(h + attn_sub(h.astype(cd)).astype(h.dtype),
+                    p["ln1_scale"], p["ln1_bias"]).astype(h.dtype)
+    h = _layer_norm(h + ffn_sub(h.astype(cd)).astype(h.dtype),
+                    p["ln2_scale"], p["ln2_bias"]).astype(h.dtype)
+    return h
 
 
 def _dropout(x, cfg, rng, salt, train):
@@ -229,7 +244,8 @@ def forward(params, tokens, cfg: TransformerConfig, *, segments=None, pad_mask=N
 
     m = params["mlm"]
     x = jax.nn.gelu(h.astype(cfg.compute_dtype) @ m["w"].astype(cfg.compute_dtype)
-                    + m["b"].astype(cfg.compute_dtype))
+                    + m["b"].astype(cfg.compute_dtype),
+                    approximate=cfg.gelu_approximate)
     x = _layer_norm(x, m["ln_scale"], m["ln_bias"])
     # tied output embedding (BERT MLM head)
     logits = x.astype(jnp.float32) @ params["embed"]["tok"].astype(jnp.float32).T

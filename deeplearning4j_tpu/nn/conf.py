@@ -48,6 +48,7 @@ class InputType:
     width: int = 0
     channels: int = 0
     timeseries_length: Optional[int] = None
+    depth: int = 0  # cnn3d (NCDHW)
 
     @staticmethod
     def feed_forward(size: int) -> "InputType":
@@ -65,11 +66,18 @@ class InputType:
     def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
         return InputType("cnnflat", height=height, width=width, channels=channels)
 
+    @staticmethod
+    def convolutional3d(depth: int, height: int, width: int, channels: int) -> "InputType":
+        """NCDHW (Convolution3D.DataFormat.NCDHW)."""
+        return InputType("cnn3d", depth=depth, height=height, width=width, channels=channels)
+
     def flat_size(self) -> int:
         if self.kind == "ff":
             return self.size
         if self.kind == "rnn":
             return self.size
+        if self.kind == "cnn3d":
+            return self.depth * self.height * self.width * self.channels
         return self.height * self.width * self.channels
 
     def to_json(self):
@@ -97,8 +105,10 @@ class Layer:
     activation: str = "identity"
     l1: float = 0.0
     l2: float = 0.0
-    dropout: float = 0.0  # keep-prob==1-dropout? DL4J: value = retain prob
+    dropout: float = 0.0  # retain prob (float) or an nn.dropout IDropout scheme
     frozen: bool = False  # FrozenLayer (TransferLearning): no param updates
+    constraints: tuple = ()      # nn.constraints.*, applied after each update
+    weight_noise: Optional[Any] = None  # nn.constraints.WeightNoise/DropConnect
 
     def output_type(self, input_type: InputType) -> InputType:
         return input_type
@@ -113,13 +123,12 @@ class Layer:
         return True
 
     def _apply_dropout(self, x, training, rng):
-        """DL4J conf .dropOut(p): p = probability of RETAINING an activation,
-        applied to the layer INPUT (Dropout.applyDropout), inverted scaling."""
-        if not training or self.dropout in (0.0, 1.0) or rng is None:
-            return x
-        keep = self.dropout
-        mask = jax.random.bernoulli(rng, keep, x.shape)
-        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+        """DL4J conf .dropOut(...): a float (probability of RETAINING an
+        activation, inverted scaling) or an IDropout scheme object
+        (nn.dropout.Gaussian*/Alpha*/Spatial*), applied to the layer INPUT."""
+        from .dropout import apply_dropout
+
+        return apply_dropout(self.dropout, x, rng, training)
 
     def to_json(self) -> dict:
         d = {}
@@ -129,6 +138,8 @@ class Layer:
                 v = v.to_json()
             elif isinstance(v, InputType):
                 v = v.to_json()
+            elif f.name == "dropout" and hasattr(v, "apply"):  # IDropout scheme
+                v = {"@dropout": type(v).__name__, **dataclasses.asdict(v)}
             d[f.name] = v
         d["@class"] = type(self).__name__
         return d
@@ -139,6 +150,11 @@ class Layer:
         cls = LAYER_REGISTRY[d.pop("@class")]
         if d.get("updater") and isinstance(d["updater"], dict):
             d["updater"] = IUpdater.from_json(d["updater"])
+        if isinstance(d.get("dropout"), dict) and "@dropout" in d["dropout"]:
+            from . import dropout as dropout_mod
+
+            dd = dict(d["dropout"])
+            d["dropout"] = getattr(dropout_mod, dd.pop("@dropout"))(**dd)
         flds = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in flds})
 
@@ -925,7 +941,7 @@ class CnnToFeedForwardPreProcessor(InputPreProcessor):
         return x.reshape(x.shape[0], -1)
 
     def output_type(self, it):
-        return InputType.feed_forward(it.height * it.width * it.channels)
+        return InputType.feed_forward(it.flat_size())
 
 
 @dataclass
@@ -976,7 +992,7 @@ def infer_preprocessor(prev: InputType, layer: Layer) -> Optional[InputPreProces
     ) and not isinstance(layer, (RnnOutputLayer, EmbeddingSequenceLayer))
     wants_cnn = isinstance(layer, (ConvolutionLayer, SubsamplingLayer, Upsampling2D, ZeroPaddingLayer, LocalResponseNormalization))
     wants_rnn = isinstance(layer, (LSTM, SimpleRnn, Bidirectional, RnnOutputLayer))
-    if prev.kind == "cnn" and wants_ff:
+    if prev.kind in ("cnn", "cnn3d") and wants_ff:
         return CnnToFeedForwardPreProcessor()
     if prev.kind == "cnnflat" and wants_cnn:
         return FeedForwardToCnnPreProcessor(prev.height, prev.width, prev.channels)

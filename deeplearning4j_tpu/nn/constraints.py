@@ -1,0 +1,123 @@
+"""Parameter constraints + weight noise.
+
+Reference: ``org.deeplearning4j.nn.conf.constraint.{MaxNormConstraint,
+MinMaxNormConstraint,UnitNormConstraint,NonNegativeConstraint}`` (applied
+after each updater step) and ``org.deeplearning4j.nn.conf.weightnoise.
+WeightNoise`` / ``DropConnect`` (applied to weights each training forward).
+SURVEY §2.4 C1 breadth gap.
+
+Constraints run INSIDE the compiled train step right after the parameter
+update (same placement as BaseConstraint.applyConstraint); weight noise is
+applied to the cast weights in the forward pass, so both compose with AMP
+and sharding for free."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class MaxNormConstraint:
+    """Clip the norm of each output unit to max_norm (norm over ``axes``)."""
+
+    max_norm: float = 2.0
+    axes: Tuple[int, ...] = (0,)
+
+    def apply(self, w):
+        n = jnp.sqrt(jnp.sum(jnp.square(w), axis=self.axes, keepdims=True) + 1e-12)
+        return w * jnp.minimum(1.0, self.max_norm / n)
+
+
+@dataclass
+class MinMaxNormConstraint:
+    """Force per-unit norms into [min_norm, max_norm] at ``rate``."""
+
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+    axes: Tuple[int, ...] = (0,)
+
+    def apply(self, w):
+        n = jnp.sqrt(jnp.sum(jnp.square(w), axis=self.axes, keepdims=True) + 1e-12)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1.0 - self.rate) * n
+        return w * (target / n)
+
+
+@dataclass
+class UnitNormConstraint:
+    axes: Tuple[int, ...] = (0,)
+
+    def apply(self, w):
+        n = jnp.sqrt(jnp.sum(jnp.square(w), axis=self.axes, keepdims=True) + 1e-12)
+        return w / n
+
+
+@dataclass
+class NonNegativeConstraint:
+    def apply(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+def apply_constraints(layer_params: dict, constraints, constrain_bias: bool = False) -> dict:
+    """Apply every constraint to each weight param (bias excluded unless
+    constrain_bias, matching BaseConstraint.paramNames handling)."""
+    if not constraints:
+        return layer_params
+    out = {}
+    for k, w in layer_params.items():
+        if k == "b" and not constrain_bias:
+            out[k] = w
+            continue
+        for c in constraints:
+            w = c.apply(w)
+        out[k] = w
+    return out
+
+
+@dataclass
+class WeightNoise:
+    """conf.weightnoise.WeightNoise: gaussian noise on weights during
+    training forward (additive N(0, stddev) or multiplicative N(1, stddev));
+    gradients flow through the noisy weights exactly as in the reference."""
+
+    stddev: float = 0.01
+    additive: bool = True
+    apply_to_bias: bool = False
+
+    def apply(self, params: dict, rng, training: bool) -> dict:
+        if not training or rng is None or self.stddev <= 0.0:
+            return params
+        out = {}
+        for i, (k, w) in enumerate(sorted(params.items())):
+            if k == "b" and not self.apply_to_bias:
+                out[k] = w
+                continue
+            noise = jax.random.normal(jax.random.fold_in(rng, i), w.shape, w.dtype) * self.stddev
+            out[k] = w + noise if self.additive else w * (1.0 + noise)
+        return out
+
+
+@dataclass
+class DropConnect:
+    """conf.weightnoise.DropConnect: bernoulli-mask weights during training
+    (p = retain probability, inverted scaling)."""
+
+    p: float = 0.5
+    apply_to_bias: bool = False
+
+    def apply(self, params: dict, rng, training: bool) -> dict:
+        if not training or rng is None or self.p in (0.0, 1.0):
+            return params
+        out = {}
+        for i, (k, w) in enumerate(sorted(params.items())):
+            if k == "b" and not self.apply_to_bias:
+                out[k] = w
+                continue
+            mask = jax.random.bernoulli(jax.random.fold_in(rng, i), self.p, w.shape)
+            out[k] = jnp.where(mask, w / self.p, 0.0).astype(w.dtype)
+        return out

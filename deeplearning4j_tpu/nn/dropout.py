@@ -1,0 +1,101 @@
+"""Dropout schemes (org.deeplearning4j.nn.conf.dropout.IDropout impls).
+
+Reference: ``Dropout``, ``GaussianDropout``, ``GaussianNoise``,
+``AlphaDropout`` + ``SpatialDropout`` (SURVEY §2.4 C1 "dropout schemes" gap).
+A layer's ``dropout`` field accepts a plain float (retain probability,
+classic DL4J ``dropOut(p)``) or one of these objects; all apply to the layer
+INPUT during training only, inside the compiled step (pure functions of the
+step rng)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class Dropout:
+    """Inverted dropout; p = probability of RETAINING an activation."""
+
+    p: float = 0.5
+
+    def apply(self, x, rng, training: bool):
+        if not training or self.p in (0.0, 1.0) or rng is None:
+            return x
+        mask = jax.random.bernoulli(rng, self.p, x.shape)
+        return jnp.where(mask, x / self.p, 0.0).astype(x.dtype)
+
+
+@dataclass
+class SpatialDropout(Dropout):
+    """Drop entire channels (feature maps / rnn channels): one bernoulli per
+    [B, C], broadcast over the spatial/time dims."""
+
+    def apply(self, x, rng, training: bool):
+        if not training or self.p in (0.0, 1.0) or rng is None:
+            return x
+        shape = x.shape[:2] + (1,) * (x.ndim - 2)
+        mask = jax.random.bernoulli(rng, self.p, shape)
+        return jnp.where(mask, x / self.p, 0.0).astype(x.dtype)
+
+
+@dataclass
+class GaussianDropout:
+    """Multiplicative gaussian noise N(1, rate/(1-rate)) (Srivastava et al.);
+    mean-preserving, no rescale needed."""
+
+    rate: float = 0.5
+
+    def apply(self, x, rng, training: bool):
+        if not training or self.rate <= 0.0 or rng is None:
+            return x
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + std * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise
+
+
+@dataclass
+class GaussianNoise:
+    """Additive gaussian noise N(0, stddev)."""
+
+    stddev: float = 0.1
+
+    def apply(self, x, rng, training: bool):
+        if not training or self.stddev <= 0.0 or rng is None:
+            return x
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+
+
+@dataclass
+class AlphaDropout:
+    """SELU-compatible dropout (Klambauer et al. 2017): keeps self-normalizing
+    mean/variance by dropping to alpha' and applying the affine correction."""
+
+    p: float = 0.5  # retain probability
+
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def apply(self, x, rng, training: bool):
+        if not training or self.p in (0.0, 1.0) or rng is None:
+            return x
+        alpha_p = -self._ALPHA * self._SCALE
+        keep = self.p
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+def apply_dropout(dropout, x, rng, training: bool):
+    """Dispatch: float (retain prob) or IDropout object or None."""
+    if dropout is None:
+        return x
+    if hasattr(dropout, "apply"):
+        return dropout.apply(x, rng, training)
+    if not training or dropout in (0.0, 1.0) or rng is None:
+        return x
+    mask = jax.random.bernoulli(rng, dropout, x.shape)
+    return jnp.where(mask, x / dropout, 0.0).astype(x.dtype)

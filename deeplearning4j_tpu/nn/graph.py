@@ -61,6 +61,10 @@ class ComputationGraph:
             if node.layer is not None and node.layer.has_params():
                 key, sub = jax.random.split(key)
                 self.params_[name] = node.layer.init_params(sub, self._in_types[name], self._dtype)
+            if node.vertex is not None and hasattr(node.vertex, "init_params"):
+                # parameterized vertex (e.g. AttentionVertex)
+                key, sub = jax.random.split(key)
+                self.params_[name] = node.vertex.init_params(sub, self._dtype)
             if isinstance(node.layer, BatchNormalization):
                 self.bn_state[name] = node.layer.init_state(self._in_types[name], self._dtype)
         self.updater_state = self.conf.updater.init(self.params_)
@@ -82,7 +86,10 @@ class ComputationGraph:
                 xs = [node.preprocessor.pre_process(xs[0], None)] + xs[1:]
             sub = jax.random.fold_in(rng, idx) if rng is not None else None
             if node.vertex is not None:
-                acts[name] = node.vertex.apply(xs)
+                if hasattr(node.vertex, "init_params"):
+                    acts[name] = node.vertex.apply(xs, params.get(name))
+                else:
+                    acts[name] = node.vertex.apply(xs)
                 continue
             layer = node.layer
             p = params.get(name, {})

@@ -145,6 +145,9 @@ class MultiLayerNetwork:
             h = self.conf.preprocessors[i].pre_process(h, it)
         p = params.get(si, {})
         sub = jax.random.fold_in(rng, i) if rng is not None else None
+        if layer.weight_noise is not None and training:
+            p = layer.weight_noise.apply(p, jax.random.fold_in(sub, 0x9015E)
+                                         if sub is not None else None, training)
         if isinstance(layer, BatchNormalization):
             out, nb = layer.forward_bn(p, new_bn[si], h, it, training=training)
             new_bn[si] = nb
@@ -154,7 +157,10 @@ class MultiLayerNetwork:
             out, hT, cT = layer.forward_with_state(p, h, h0, c0)
             new_rnn[si] = (hT, cT)
             return out
-        if isinstance(layer, (LastTimeStep, GlobalPoolingLayer)):
+        from .attention_layers import LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer
+
+        if isinstance(layer, (LastTimeStep, GlobalPoolingLayer, SelfAttentionLayer,
+                              LearnedSelfAttentionLayer, RecurrentAttentionLayer)):
             return layer.forward(p, h, it, training=training, rng=sub, mask=fmask)
         return layer.forward(p, h, it, training=training, rng=sub)
 
@@ -212,6 +218,7 @@ class MultiLayerNetwork:
             grads = _grad_normalize(grads, gn, gnt)
             updates, new_upd = updater.apply(grads, upd_state, params, iteration, epoch)
             new_params = jax.tree.map(lambda p, u: p - u, params, updates)
+            new_params = self._apply_constraints(new_params)
             return new_params, new_upd, new_bn, loss
 
         jitted = jax.jit(step, donate_argnums=(0, 1, 2))
@@ -239,6 +246,7 @@ class MultiLayerNetwork:
             grads = _grad_normalize(grads, gn, gnt)
             updates, new_upd = updater.apply(grads, upd_state, params, iteration, epoch)
             new_params = jax.tree.map(lambda p, u: p - u, params, updates)
+            new_params = self._apply_constraints(new_params)
             # stop grads flowing across segments (tBPTT semantics)
             new_rnn = jax.tree.map(jax.lax.stop_gradient, new_rnn)
             return new_params, new_upd, new_bn, new_rnn, loss
@@ -246,6 +254,18 @@ class MultiLayerNetwork:
         jitted = jax.jit(step, donate_argnums=(0, 1, 2, 3))
         self._jit_cache[cache_key] = jitted
         return jitted
+
+    def _apply_constraints(self, params):
+        """Post-update constraint projection (BaseConstraint.applyConstraint
+        placement) — runs inside the compiled step."""
+        from .constraints import apply_constraints
+
+        out = dict(params)
+        for i, layer in enumerate(self.conf.layers):
+            si = str(i)
+            if layer.constraints and si in out:
+                out[si] = apply_constraints(out[si], layer.constraints)
+        return out
 
     # ------------------------------------------------------------------- fit
 

@@ -64,6 +64,11 @@ class Collectives:
     def gather(self, name: str, value: Any, root: int = 0) -> Optional[List[Any]]:
         raise NotImplementedError
 
+    def allgather(self, name: str, value: Any) -> List[Any]:
+        """Every rank contributes a blob, every rank receives the full
+        rank-ordered list (the DCN gradient-exchange primitive)."""
+        raise NotImplementedError
+
 
 class SingleProcessCollectives(Collectives):
     """Trivial impl for one-process runs (the common single-host case)."""
@@ -75,6 +80,9 @@ class SingleProcessCollectives(Collectives):
         return value
 
     def gather(self, name: str, value: Any, root: int = 0):
+        return [value]
+
+    def allgather(self, name: str, value: Any):
         return [value]
 
 
@@ -177,3 +185,7 @@ class FakeWorkerCollectives(Collectives):
         if self.rank == root:
             return [slot[i] for i in sorted(slot)]
         return None
+
+    def allgather(self, name: str, value: Any):
+        slot = self.router._rendezvous(name, self.rank, value)
+        return [slot[i] for i in sorted(slot)]

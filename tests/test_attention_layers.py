@@ -1,0 +1,184 @@
+"""Attention config layers (SURVEY §2.4 C1, VERDICT r1 Missing #7): the
+DL4J builder surface can now express attention models, gradient-checked and
+trainable end-to-end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn import (
+    AttentionVertex,
+    LearnedSelfAttentionLayer,
+    RecurrentAttentionLayer,
+    SelfAttentionLayer,
+)
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    GlobalPoolingLayer,
+    InputType,
+    Layer,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def _seq_data(rs, B=8, C=6, T=10, classes=3):
+    x = rs.rand(B, C, T).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rs.randint(0, classes, B)]
+    return x, y
+
+
+def test_self_attention_layer_trains():
+    rs = np.random.RandomState(0)
+    x, y = _seq_data(rs)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).updater(Adam(5e-3)).list()
+        .layer(SelfAttentionLayer(n_out=8, n_heads=2, project_input=True))
+        .layer(GlobalPoolingLayer(pooling_type="avg"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(6))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(x, y))
+    l0 = net.score_
+    for _ in range(30):
+        net.fit(DataSet(x, y))
+    assert net.score_ < l0
+    out = net.output(x).numpy()
+    assert out.shape == (8, 3)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+
+def test_self_attention_unprojected_single_head():
+    rs = np.random.RandomState(1)
+    layer = SelfAttentionLayer(n_in=6, n_heads=1, project_input=False)
+    x = jnp.asarray(rs.rand(4, 6, 10), jnp.float32)
+    out = layer.forward({}, x, InputType.recurrent(6), training=False)
+    assert out.shape == (4, 6, 10)
+    import pytest
+    with pytest.raises(ValueError):
+        SelfAttentionLayer(n_heads=2, project_input=False)
+
+
+def test_self_attention_respects_mask():
+    """Masked (padded) timesteps must not change unmasked outputs... they DO
+    change outputs when the mask is absent — assert the mask makes the padded
+    and truncated sequences agree."""
+    rs = np.random.RandomState(2)
+    layer = SelfAttentionLayer(n_in=6, n_out=6, n_heads=2, head_size=3)
+    params = layer.init_params(jax.random.key(0), InputType.recurrent(6))
+    x_short = jnp.asarray(rs.rand(2, 6, 5), jnp.float32)
+    x_pad = jnp.concatenate([x_short, jnp.ones((2, 6, 3))], axis=2)
+    mask = jnp.concatenate([jnp.ones((2, 5)), jnp.zeros((2, 3))], axis=1)
+    o_short = layer.forward(params, x_short, InputType.recurrent(6), training=False)
+    o_pad = layer.forward(params, x_pad, InputType.recurrent(6), training=False, mask=mask)
+    np.testing.assert_allclose(o_short, o_pad[..., :5], atol=1e-5)
+
+
+def test_learned_self_attention_fixed_output_length():
+    rs = np.random.RandomState(3)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).updater(Adam(5e-3)).list()
+        .layer(LearnedSelfAttentionLayer(n_out=8, n_heads=2, n_queries=4))
+        .layer(GlobalPoolingLayer(pooling_type="avg"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(6))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x, y = _seq_data(rs, T=12)
+    net.fit(DataSet(x, y))
+    l0 = net.score_
+    for _ in range(20):
+        net.fit(DataSet(x, y))
+    assert net.score_ < l0
+    # pooling is over the FIXED n_queries axis regardless of input T
+    x2, _ = _seq_data(rs, T=12)
+    assert net.output(x2).numpy().shape == (8, 3)
+
+
+def test_recurrent_attention_layer_trains():
+    rs = np.random.RandomState(4)
+    x, y = _seq_data(rs, C=5, T=8)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(2).updater(Adam(5e-3)).list()
+        .layer(RecurrentAttentionLayer(n_in=5, n_out=8, n_heads=2, head_size=4))
+        .layer(RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(5))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    yt = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (8, 8))].transpose(0, 2, 1)
+    net.fit(DataSet(x, yt))
+    l0 = net.score_
+    for _ in range(20):
+        net.fit(DataSet(x, yt))
+    assert net.score_ < l0
+
+
+def test_attention_vertex_in_graph():
+    rs = np.random.RandomState(5)
+    x, y = _seq_data(rs, C=6, T=10)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(3).updater(Adam(5e-3))
+        .graph_builder()
+        .add_inputs("in")
+        .set_input_types(InputType.recurrent(6))
+        .add_vertex("attn", AttentionVertex(n_in=6, n_out=8, n_heads=2, head_size=4), "in")
+        .add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "attn")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "pool")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    assert "attn" in g.params_  # parameterized vertex got params
+    g.fit(DataSet(x, y))
+    l0 = g.score_
+    for _ in range(25):
+        g.fit(DataSet(x, y))
+    assert g.score_ < l0
+
+
+def test_attention_gradcheck():
+    """Finite-difference gradient check on SelfAttentionLayer params."""
+    rs = np.random.RandomState(6)
+    layer = SelfAttentionLayer(n_in=4, n_out=4, n_heads=2, head_size=2)
+    params = layer.init_params(jax.random.key(0), InputType.recurrent(4))
+    x = jnp.asarray(rs.rand(2, 4, 6), jnp.float32)
+
+    def loss(p):
+        return jnp.sum(layer.forward(p, x, InputType.recurrent(4), training=False) ** 2)
+
+    g = jax.grad(loss)(params)
+    eps = 1e-3  # fp32 central differences
+    for name in ("Wq", "Wo"):
+        w = params[name]
+        idx = (0, 1)
+        pp = {**params, name: w.at[idx].add(eps)}
+        pm = {**params, name: w.at[idx].add(-eps)}
+        fd = (loss(pp) - loss(pm)) / (2 * eps)
+        np.testing.assert_allclose(float(g[name][idx]), float(fd), rtol=2e-2, atol=1e-4)
+
+
+def test_attention_layer_serde_roundtrip():
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).list()
+        .layer(SelfAttentionLayer(n_out=8, n_heads=2))
+        .layer(GlobalPoolingLayer(pooling_type="avg"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(6))
+        .build()
+    )
+    import json
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+    j = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(j)
+    assert isinstance(conf2.layers[0], SelfAttentionLayer)
+    assert conf2.layers[0].n_heads == 2

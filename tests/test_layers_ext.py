@@ -1,0 +1,210 @@
+"""Extended layers, dropout schemes, constraints, weight noise
+(SURVEY §2.4 C1 breadth — VERDICT r1 item #8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.constraints import (
+    DropConnect,
+    MaxNormConstraint,
+    NonNegativeConstraint,
+    UnitNormConstraint,
+    WeightNoise,
+)
+from deeplearning4j_tpu.nn.dropout import (
+    AlphaDropout,
+    GaussianDropout,
+    GaussianNoise,
+    SpatialDropout,
+)
+from deeplearning4j_tpu.nn.layers_ext import (
+    CenterLossOutputLayer,
+    Convolution3D,
+    Cropping2D,
+    LocallyConnected2D,
+    PReLULayer,
+    Subsampling3DLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def test_conv3d_stack_trains():
+    rs = np.random.RandomState(0)
+    x = rs.rand(4, 1, 6, 6, 6).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 4)]
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).updater(Adam(3e-3)).list()
+        .layer(Convolution3D(n_out=4, kernel_size=(2, 2, 2), activation="relu"))
+        .layer(Subsampling3DLayer(kernel_size=(2, 2, 2), stride=(2, 2, 2)))
+        .layer(DenseLayer(n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional3d(6, 6, 6, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(x, y))
+    l0 = net.score_
+    for _ in range(20):
+        net.fit(DataSet(x, y))
+    assert net.score_ < l0
+
+
+def test_locally_connected_vs_shared_conv_shapes():
+    rs = np.random.RandomState(1)
+    layer = LocallyConnected2D(n_in=2, n_out=3, kernel_size=(2, 2), stride=(1, 1))
+    it = InputType.convolutional(5, 5, 2)
+    params = layer.init_params(jax.random.key(0), it)
+    assert params["W"].shape == (16, 8, 3)  # 4x4 positions, 2*2*2 patch, 3 out
+    x = jnp.asarray(rs.rand(3, 2, 5, 5), jnp.float32)
+    out = layer.forward(params, x, it, training=False)
+    assert out.shape == (3, 3, 4, 4)
+    # unshared: permuting position weights changes outputs at those positions only
+    w2 = params["W"].at[0].multiply(2.0)
+    out2 = layer.forward({**params, "W": w2}, x, it, training=False)
+    diff = np.abs(np.asarray(out2 - out)).reshape(3, 3, 16).sum(axis=(0, 1))
+    assert diff[0] > 0 and np.allclose(diff[1:], 0)
+
+
+def test_prelu_layer():
+    layer = PReLULayer()
+    it = InputType.feed_forward(4)
+    params = layer.init_params(jax.random.key(0), it)
+    assert params["alpha"].shape == (4,)
+    x = jnp.asarray([[-2.0, -1.0, 1.0, 2.0]])
+    # alpha starts at 0 → ReLU behavior
+    np.testing.assert_allclose(layer.forward(params, x, it, training=False),
+                               [[0, 0, 1, 2]])
+    p2 = {"alpha": jnp.full((4,), 0.5)}
+    np.testing.assert_allclose(layer.forward(p2, x, it, training=False),
+                               [[-1, -0.5, 1, 2]])
+
+
+def test_cropping2d():
+    layer = Cropping2D(cropping=(1, 1, 2, 0))
+    x = jnp.arange(2 * 1 * 6 * 6, dtype=jnp.float32).reshape(2, 1, 6, 6)
+    out = layer.forward({}, x, None, training=False)
+    assert out.shape == (2, 1, 4, 4)
+    np.testing.assert_allclose(out, x[:, :, 1:5, 2:6])
+
+
+def test_center_loss_output_layer_trains():
+    rs = np.random.RandomState(2)
+    x = rs.rand(32, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 32)]
+    conf = (
+        NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2)).list()
+        .layer(DenseLayer(n_in=6, n_out=8, activation="relu"))
+        .layer(CenterLossOutputLayer(n_out=3, lambda_=1e-2))
+        .set_input_type(InputType.feed_forward(6))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(x, y))
+    l0 = net.score_
+    for _ in range(30):
+        net.fit(DataSet(x, y))
+    assert net.score_ < l0
+    # centers moved off their zero init toward the class features
+    assert np.abs(np.asarray(net.params_["1"]["centers"])).sum() > 0
+
+
+@pytest.mark.parametrize("scheme", [
+    GaussianDropout(0.3), GaussianNoise(0.2), AlphaDropout(0.8), SpatialDropout(0.5)])
+def test_dropout_schemes(scheme):
+    rng = jax.random.key(0)
+    x = jnp.ones((8, 4, 10))
+    out_train = scheme.apply(x, rng, True)
+    out_eval = scheme.apply(x, rng, False)
+    np.testing.assert_array_equal(out_eval, x)  # inference: identity
+    assert not np.allclose(out_train, x)        # training: perturbs
+    if isinstance(scheme, SpatialDropout):
+        # whole channels dropped: each [b, c] row is all-zero or all-scaled
+        arr = np.asarray(out_train)
+        per_chan = arr.reshape(8, 4, 10)
+        for b in range(8):
+            for c in range(4):
+                vals = np.unique(per_chan[b, c])
+                assert len(vals) == 1
+
+
+def test_dropout_scheme_in_layer_and_serde():
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu",
+                          dropout=GaussianDropout(0.2)))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert isinstance(conf2.layers[0].dropout, GaussianDropout)
+    assert conf2.layers[0].dropout.rate == 0.2
+    rs = np.random.RandomState(0)
+    net = MultiLayerNetwork(conf2).init()
+    net.fit(rs.rand(16, 4).astype(np.float32),
+            np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)], epochs=2)
+    assert np.isfinite(net.score_)
+
+
+def test_constraints_applied_after_update():
+    rs = np.random.RandomState(3)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).updater(Adam(5e-2)).list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu",
+                          constraints=(MaxNormConstraint(0.5, axes=(0,)),)))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent",
+                           constraints=(NonNegativeConstraint(),)))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = rs.rand(32, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)]
+    for _ in range(5):
+        net.fit(DataSet(x, y))
+    w0 = np.asarray(net.params_["0"]["W"])
+    norms = np.sqrt((w0 ** 2).sum(axis=0))
+    assert (norms <= 0.5 + 1e-5).all()
+    assert (np.asarray(net.params_["1"]["W"]) >= 0).all()
+
+
+def test_unit_norm_constraint():
+    w = jnp.asarray(np.random.RandomState(0).rand(5, 3) * 4)
+    out = UnitNormConstraint(axes=(0,)).apply(w)
+    np.testing.assert_allclose(np.sqrt((np.asarray(out) ** 2).sum(0)), 1.0, atol=1e-5)
+
+
+def test_weight_noise_and_dropconnect():
+    rs = np.random.RandomState(4)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2)).list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu",
+                          weight_noise=WeightNoise(stddev=0.05)))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent",
+                           weight_noise=DropConnect(0.8)))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = rs.rand(16, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+    net.fit(DataSet(x, y))
+    l0 = net.score_
+    for _ in range(20):
+        net.fit(DataSet(x, y))
+    assert np.isfinite(net.score_)
+    # inference is deterministic (no noise outside training)
+    o1, o2 = net.output(x).numpy(), net.output(x).numpy()
+    np.testing.assert_array_equal(o1, o2)

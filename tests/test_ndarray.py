@@ -324,3 +324,34 @@ class TestRng:
         m = get_random().dropout_mask((10000,), keep_prob=0.5).numpy()
         assert set(np.unique(m)).issubset({0.0, 2.0})
         assert abs(m.mean() - 1.0) < 0.1
+
+
+def test_memory_workspace_facade():
+    """§2.9 N4: the workspace API exists as a documented no-op/HBM-hint
+    facade — scopes nest, the manager caches per-thread, and detach/leverage
+    are identity (XLA owns HBM)."""
+    from deeplearning4j_tpu.ndarray import (
+        WorkspaceConfiguration, current_workspace, workspace_manager)
+
+    mgr = workspace_manager()
+    assert current_workspace() is None
+    cfg = WorkspaceConfiguration(initial_size=1 << 20, policy_learning="FIRST_LOOP")
+    with mgr.get_and_activate_workspace(cfg, "WS_TEST") as ws:
+        assert ws.is_scope_active()
+        assert current_workspace() is ws
+        with mgr.get_and_activate_workspace(cfg, "WS_INNER") as inner:
+            assert current_workspace() is inner
+            with mgr.scope_out_of_workspaces():
+                assert current_workspace() is None  # detached scope
+            assert current_workspace() is inner
+        assert current_workspace() is ws
+    assert not ws.is_scope_active()
+    assert ws.generation == 1
+    # same id on the same thread returns the cached workspace
+    assert mgr.get_workspace_for_current_thread("WS_TEST") is ws
+    # arrays are always "detached" in the reference's sense — detach() is a
+    # plain dup, never tied to a workspace lifetime
+    import deeplearning4j_tpu.ndarray as nd
+    import numpy as np
+    a = nd.ones(3)
+    np.testing.assert_array_equal(a.detach().numpy(), a.numpy())
