@@ -233,6 +233,8 @@ def forward(params, tokens, cfg: TransformerConfig, *, segments=None, pad_mask=N
     h = e["tok"][tokens] + e["pos"][:T][None]
     if segments is not None:
         h = h + e["seg"][segments]
+    elif cfg.type_vocab > 0:
+        h = h + e["seg"][0]  # BERT semantics: token_type defaults to segment 0
     h = _layer_norm(h, e["ln_scale"], e["ln_bias"]).astype(cfg.compute_dtype)
 
     block = functools.partial(_block, cfg)
