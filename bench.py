@@ -11,9 +11,10 @@ recorded alongside each number per BASELINE.md's measurement protocol.
 
 No reference numbers exist to compare against (BASELINE.json "published" is
 empty), so vs_baseline is the ratio against this repo's own previous round,
-read from the per-backend BENCH_BASELINE.<backend>.json (legacy
-BENCH_BASELINE.json honored when its backend matches — never overwritten by
-a different backend's run; ADVICE r1).
+read from the per-backend BENCH_BASELINE.<backend>.json. A stored baseline is
+only comparable when its measurement config (batch / image size / effective
+matmul precision) matches the current run (ADVICE r2); an off-config run
+reports vs_baseline=1.0 without touching the stored baseline.
 """
 
 from __future__ import annotations
@@ -99,22 +100,25 @@ def bench_lenet(p):
     t0 = time.perf_counter()
     tta = None
     images = 0
+    train_time = 0.0  # ADVICE r2: exclude evaluate() from the throughput denominator
     for epoch in range(p["max_epochs"]):
         train_it.reset()
+        te = time.perf_counter()
         for ds in train_it:
             net.fit(ds)
             images += ds.features.shape[0]
+        train_time += time.perf_counter() - te
         test_it.reset()
         acc = net.evaluate(test_it).accuracy()
         if acc >= p["target_acc"]:
             tta = time.perf_counter() - t0
             break
-    total = time.perf_counter() - t0
     return {"metric": "lenet_mnist_time_to_accuracy",
             "value": round(tta, 2) if tta is not None else None,  # null = not reached (valid JSON)
             "unit": f"sec_to_{p['target_acc']:.0%}_acc",
             "reached": tta is not None, "final_acc": round(float(acc), 4),
-            "images_per_sec": round(images / total, 1)}
+            "synthetic": bool(getattr(train_it, "synthetic", False)),
+            "images_per_sec": round(images / train_time, 1)}
 
 
 # -------------------------------------------------------- graveslstm char-rnn
@@ -210,25 +214,25 @@ def bench_bert(p):
 # --------------------------------------------------------------------- driver
 
 
-def _baseline_ratio(backend, value):
+def _baseline_ratio(backend, value, config):
     """Per-backend self-relative trend (ADVICE r1: never cross-compare or
-    clobber another backend's baseline)."""
+    clobber another backend's baseline; ADVICE r2: only compare runs whose
+    measurement config — batch/image size/precision — matches, and re-seed
+    the baseline when the config changes)."""
     per = _HERE / f"BENCH_BASELINE.{backend}.json"
-    legacy = _HERE / "BENCH_BASELINE.json"
-    prev = None
-    for f in (per, legacy):
-        if f.exists():
-            try:
-                d = json.loads(f.read_text())
-                if d.get("backend") == backend:
-                    prev = d.get("value")
-                    break
-            except Exception:
-                pass
-    if prev:
-        return value / prev
+    if per.exists():
+        try:
+            d = json.loads(per.read_text())
+            if d.get("backend") == backend and d.get("config") == config:
+                return value / d["value"]
+        except Exception:
+            pass
+        # existing baseline with a different config: incomparable — leave the
+        # stored trend intact so one off-config run can't reset the history
+        return 1.0
     per.write_text(json.dumps({"metric": "resnet50_train_images_per_sec",
-                               "value": value, "backend": backend}))
+                               "value": value, "backend": backend,
+                               "config": config}))
     return 1.0
 
 
@@ -239,8 +243,6 @@ BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
 def main():
     import jax
 
-    from deeplearning4j_tpu.common.environment import env
-
     backend = jax.default_backend()
     params = _scale(backend == "tpu")
     only = sys.argv[1] if len(sys.argv) > 1 else None
@@ -250,15 +252,20 @@ def main():
 
     results = {name: BENCHES[name](params[name]) for name in names}
 
+    from deeplearning4j_tpu.common.precision import compute_dtype
+
+    effective_precision = compute_dtype().__name__  # resolves 'auto' per backend
     head = results.get("resnet50") or results[names[0]]
+    head_cfg = {"batch": head.get("batch"), "image_size": head.get("image_size"),
+                "matmul_precision": effective_precision}
     out = {
         "metric": head["metric"],
         "value": head["value"],
         "unit": head["unit"],
-        "vs_baseline": round(_baseline_ratio(backend, head["value"]), 3)
+        "vs_baseline": round(_baseline_ratio(backend, head["value"], head_cfg), 3)
         if head["metric"] == "resnet50_train_images_per_sec" else 1.0,
         "backend": backend,
-        "matmul_precision": env().matmul_precision,
+        "matmul_precision": effective_precision,
         "configs": results,
     }
     print(json.dumps(out))

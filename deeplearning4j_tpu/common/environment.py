@@ -23,7 +23,7 @@ class _Flags:
     check_nan: bool = False          # TDL_CHECK_NAN — NaN panic after each op
     check_inf: bool = False          # TDL_CHECK_INF — Inf panic after each op
     default_float: str = "float32"   # TDL_DEFAULT_FLOAT — eager default dtype
-    matmul_precision: str = "bfloat16"  # TDL_MATMUL_PRECISION — bf16|float32|tf32
+    matmul_precision: str = "auto"   # TDL_MATMUL_PRECISION — auto|bf16|float32|tf32
     max_host_threads: int = 0        # TDL_MAX_HOST_THREADS — 0 = auto
     eager_cache_size: int = 4096     # TDL_EAGER_CACHE_SIZE — compiled-op LRU cap
     seed: int = 0                    # TDL_SEED — initial global RNG seed

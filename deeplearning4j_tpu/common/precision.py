@@ -16,9 +16,12 @@ compiled train step:
   updater sees fp32 grads against fp32 masters.
 
 Policy values (env ``TDL_MATMUL_PRECISION`` or ``env().set(...)``):
-``bfloat16``/``bf16`` → AMP as above; ``float32``/``highest`` → everything
-fp32 (the numerics-testing default); ``tf32`` → treated as float32 on TPU
-(no tf32 unit; XLA's fp32 matmul already runs multi-pass bf16 on the MXU).
+``auto`` (default) → bf16 AMP on TPU backends, fp32 everywhere else, so
+CPU/dev runs keep the reference's fp32-default training numerics while the
+TPU path gets MXU-rate bf16; ``bfloat16``/``bf16`` → AMP unconditionally;
+``float32``/``highest`` → everything fp32 (the numerics-testing default);
+``tf32`` → treated as float32 on TPU (no tf32 unit; XLA's fp32 matmul
+already runs multi-pass bf16 on the MXU).
 """
 
 from __future__ import annotations
@@ -33,6 +36,10 @@ def compute_dtype():
     """The activation/matmul dtype the current policy dictates."""
     p = str(env().matmul_precision).lower()
     if p in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    if p == "auto" and jax.default_backend() not in ("cpu",):
+        # accelerator backends (tpu / the axon tunnel) default to bf16 AMP;
+        # CPU keeps fp32 so dev runs match reference numerics (ADVICE r2)
         return jnp.bfloat16
     return jnp.float32
 

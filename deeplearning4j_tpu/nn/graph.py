@@ -93,6 +93,9 @@ class ComputationGraph:
                 continue
             layer = node.layer
             p = params.get(name, {})
+            if layer.weight_noise is not None and training:
+                p = layer.weight_noise.apply(p, jax.random.fold_in(sub, 0x9015E)
+                                             if sub is not None else None, training)
             it = self._in_types[name]
             is_output = name in self.conf.network_outputs and hasattr(layer, "compute_loss")
             if labels is not None and is_output:
@@ -151,10 +154,22 @@ class ComputationGraph:
             grads = _grad_normalize(grads, gn, gnt)
             updates, new_upd = updater.apply(grads, upd_state, params, iteration, epoch)
             new_params = jax.tree.map(lambda p, u: p - u, params, updates)
+            new_params = self._apply_constraints(new_params)
             return new_params, new_upd, new_bn, loss
 
         self._jit_cache[cache_key] = jax.jit(step, donate_argnums=(0, 1, 2))
         return self._jit_cache[cache_key]
+
+    def _apply_constraints(self, params):
+        """Post-update constraint projection inside the compiled step (parity
+        with MultiLayerNetwork; ADVICE r2: CG previously ignored constraints)."""
+        from .constraints import apply_constraints
+
+        out = dict(params)
+        for name, node in self.conf.nodes.items():
+            if node.layer is not None and node.layer.constraints and name in out:
+                out[name] = apply_constraints(out[name], node.layer.constraints)
+        return out
 
     def _coerce_inputs(self, features) -> Dict[str, jnp.ndarray]:
         if isinstance(features, dict):

@@ -208,3 +208,35 @@ def test_weight_noise_and_dropconnect():
     # inference is deterministic (no noise outside training)
     o1, o2 = net.output(x).numpy(), net.output(x).numpy()
     np.testing.assert_array_equal(o1, o2)
+
+
+def test_cg_constraints_and_weight_noise():
+    """ADVICE r2: ComputationGraph must honor constraints + weight noise."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    rs = np.random.RandomState(5)
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).updater(Adam(5e-2))
+        .graph_builder()
+        .add_inputs("in")
+        .set_input_types(InputType.feed_forward(4))
+        .add_layer("d1", DenseLayer(n_out=8, activation="relu",
+                                    constraints=(MaxNormConstraint(0.5, axes=(0,)),),
+                                    weight_noise=WeightNoise(stddev=0.05)), "in")
+        .add_layer("out", OutputLayer(n_out=2, activation="softmax", loss="mcxent",
+                                      constraints=(NonNegativeConstraint(),)), "d1")
+        .set_outputs("out")
+        .build()
+    )
+    g = ComputationGraph(conf).init()
+    x = rs.rand(32, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)]
+    for _ in range(5):
+        g.fit(DataSet(x, y))
+    w = np.asarray(g.params_["d1"]["W"])
+    assert (np.sqrt((w ** 2).sum(axis=0)) <= 0.5 + 1e-5).all()
+    assert (np.asarray(g.params_["out"]["W"]) >= 0).all()
+    # weight noise is train-only: inference deterministic
+    o1 = g.output_single(x).numpy()
+    o2 = g.output_single(x).numpy()
+    np.testing.assert_array_equal(o1, o2)
