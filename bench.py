@@ -217,19 +217,21 @@ def bench_bert(p):
 def _baseline_ratio(backend, value, config):
     """Per-backend self-relative trend (ADVICE r1: never cross-compare or
     clobber another backend's baseline; ADVICE r2: only compare runs whose
-    measurement config — batch/image size/precision — matches, and re-seed
-    the baseline when the config changes)."""
+    measurement config — batch/image size/precision — matches). An off-config
+    run reports 1.0 and leaves the stored baseline untouched; only a missing
+    or corrupt baseline file is (re-)seeded."""
     per = _HERE / f"BENCH_BASELINE.{backend}.json"
     if per.exists():
         try:
             d = json.loads(per.read_text())
+        except Exception:
+            d = None  # corrupt file: fall through and re-seed below
+        if d is not None:
             if d.get("backend") == backend and d.get("config") == config:
                 return value / d["value"]
-        except Exception:
-            pass
-        # existing baseline with a different config: incomparable — leave the
-        # stored trend intact so one off-config run can't reset the history
-        return 1.0
+            # valid baseline with a different config: incomparable — leave
+            # the stored trend intact so one off-config run can't reset it
+            return 1.0
     per.write_text(json.dumps({"metric": "resnet50_train_images_per_sec",
                                "value": value, "backend": backend,
                                "config": config}))

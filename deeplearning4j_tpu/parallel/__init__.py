@@ -1,9 +1,14 @@
 from .mesh import MeshSpec, build_mesh, device_count
 from .sharding import ShardingRules, DP, TP_COLUMN, TP_ROW, replicated, shard_batch, shard_params
-from .trainer import ParallelTrainer, ParameterAveragingTrainingMaster, SharedTrainingMaster
+from .trainer import (
+    MultiProcessTrainer,
+    ParallelTrainer,
+    ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+)
 from .wrapper import ParallelWrapper
 from .inference import ParallelInference
-from . import collectives, compression
+from . import collectives, compression, launcher
 
 __all__ = [
     "MeshSpec",
@@ -17,9 +22,11 @@ __all__ = [
     "shard_batch",
     "shard_params",
     "ParallelTrainer",
+    "MultiProcessTrainer",
     "ParameterAveragingTrainingMaster",
     "SharedTrainingMaster",
     "ParallelWrapper",
     "ParallelInference",
     "collectives",
+    "launcher",
 ]

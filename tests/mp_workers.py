@@ -1,0 +1,176 @@
+"""Worker targets for the multi-process distributed tests.
+
+Each function runs inside a freshly-spawned worker process AFTER
+``launcher.initialize()`` (so jax already sees the global device set).
+Results are written to the file named by TDL_MP_OUT (one file per rank) for
+the parent pytest process to assert on — mirrors how the reference's
+local-Spark tests collect per-executor results (SURVEY §4.4).
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+def _out_path(rank):
+    return os.environ["TDL_MP_OUT"] + f".rank{rank}"
+
+
+def _write(rank, payload):
+    with open(_out_path(rank), "w") as f:
+        json.dump(payload, f)
+
+
+def _toy_net(seed=7):
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2)).list()
+        .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(6))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _global_batch(step, n=16):
+    """Deterministic batch keyed by step — identical on every process."""
+    rs = np.random.RandomState(1000 + step)
+    x = rs.rand(n, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+    return x, y
+
+
+def allgather_blobs():
+    """SPI smoke: pickled blob allgather over the real process boundary."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+
+    col = ProcessCollectives()
+    rank = col.rank
+    blobs = col.allgather("smoke", {"rank": rank, "payload": "x" * (10 + rank * 100)})
+    col.barrier("done")
+    _write(rank, {
+        "world": col.world,
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "gathered_ranks": [b["rank"] for b in blobs],
+        "lens": [len(b["payload"]) for b in blobs],
+    })
+
+
+def dp_train():
+    """2-process data-parallel fit via MultiProcessTrainer; every process
+    writes its final params hash + losses; parent asserts cross-process
+    equality AND equality with a single-process reference run."""
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.trainer import MultiProcessTrainer
+
+    col = ProcessCollectives()
+    rank, world = col.rank, col.world
+    net = _toy_net()
+    trainer = MultiProcessTrainer(net, build_mesh(data=-1))
+
+    steps = 6
+    losses = []
+    for step in range(steps):
+        x, y = _global_batch(step)
+        # each process feeds its local shard (standard SPMD input pipeline)
+        lo = rank * (len(x) // world)
+        hi = lo + len(x) // world
+        trainer.fit([DataSet(x[lo:hi], y[lo:hi])])
+        losses.append(net.score_)
+
+    flat = np.asarray(net.params().numpy(), np.float64)
+    _write(rank, {
+        "losses": [float(l) for l in losses],
+        "param_sum": float(flat.sum()),
+        "param_norm": float(np.linalg.norm(flat)),
+        "global_devices": jax.device_count(),
+    })
+
+
+def grad_exchange():
+    """EncodedGradientsAccumulator across a genuine process boundary."""
+    from deeplearning4j_tpu.parallel.compression import EncodedGradientsAccumulator
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+
+    col = ProcessCollectives()
+    rank = col.rank
+    acc = EncodedGradientsAccumulator(col, threshold=0.1)
+    rs = np.random.RandomState(42)  # same stream every rank
+    g_all = rs.randn(2, 257).astype(np.float32) * 0.3
+    mine = g_all[rank]
+    upd1 = acc.exchange(mine)
+    upd2 = acc.exchange(mine)
+    _write(rank, {
+        "upd1_sum": float(upd1.sum()),
+        "upd2_sum": float(upd2.sum()),
+        "residual_norm": float(np.linalg.norm(acc.residual)),
+    })
+
+
+def ckpt_train():
+    """Training loop with rotating checkpoints; rank 1 optionally crashes at
+    TDL_MP_DIE_AT (simulated preemption). On TDL_MP_RESTORE=1 the run resumes
+    from the newest checkpoint instead of a fresh init."""
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.trainer import MultiProcessTrainer
+    from deeplearning4j_tpu.serde.model_serializer import ModelSerializer
+
+    col = ProcessCollectives()
+    rank, world = col.rank, col.world
+    ckpt_dir = os.environ["TDL_MP_CKPT"]
+    die_at = int(os.environ.get("TDL_MP_DIE_AT", "-1"))
+    total_steps = int(os.environ.get("TDL_MP_STEPS", "8"))
+    every = int(os.environ.get("TDL_MP_CKPT_EVERY", "2"))
+
+    net = _toy_net()
+    start = 0
+    marker = os.path.join(ckpt_dir, "latest.json")
+    if os.environ.get("TDL_MP_RESTORE") == "1" and os.path.exists(marker):
+        with open(marker) as f:
+            meta = json.load(f)
+        restored = ModelSerializer.restore_multi_layer_network(meta["path"], load_updater=True)
+        net = restored
+        net.iteration = meta["iteration"]
+        start = meta["step"]
+
+    trainer = MultiProcessTrainer(net, build_mesh(data=-1))
+    losses = []
+    for step in range(start, total_steps):
+        x, y = _global_batch(step)
+        lo = rank * (len(x) // world)
+        hi = lo + len(x) // world
+        trainer.fit([DataSet(x[lo:hi], y[lo:hi])])
+        losses.append(net.score_)
+        if (step + 1) % every == 0:
+            col.barrier(f"ckpt-{step}")
+            if rank == 0:  # process-0 writes (params replicated = identical)
+                path = os.path.join(ckpt_dir, f"ckpt-{step}.zip")
+                ModelSerializer.write_model(net, path, save_updater=True)
+                with open(marker, "w") as f:
+                    json.dump({"path": path, "step": step + 1,
+                               "iteration": net.iteration}, f)
+            col.barrier(f"ckpt-done-{step}")
+        if rank == 1 and die_at == step:
+            os._exit(17)  # simulated preemption: hard kill, no cleanup
+
+    flat = np.asarray(net.params().numpy(), np.float64)
+    _write(rank, {"losses": [float(l) for l in losses],
+                  "param_sum": float(flat.sum()),
+                  "param_norm": float(np.linalg.norm(flat)),
+                  "start": start})

