@@ -225,17 +225,42 @@ def _dropout(x, cfg, rng, salt, train):
     return jnp.where(mask, x / keep, 0.0)
 
 
-def forward(params, tokens, cfg: TransformerConfig, *, segments=None, pad_mask=None,
-            rng=None, train: bool = False):
-    """tokens [B,T] int32 → logits [B,T,V] (float32)."""
-    B, T = tokens.shape
+def embed(params, tokens, cfg: TransformerConfig, *, segments=None):
+    """Embedding front-end: tokens [B,T] → block input [B,T,D] (compute dtype)."""
+    T = tokens.shape[-1]
     e = params["embed"]
     h = e["tok"][tokens] + e["pos"][:T][None]
     if segments is not None:
         h = h + e["seg"][segments]
     elif cfg.type_vocab > 0:
         h = h + e["seg"][0]  # BERT semantics: token_type defaults to segment 0
-    h = _layer_norm(h, e["ln_scale"], e["ln_bias"]).astype(cfg.compute_dtype)
+    return _layer_norm(h, e["ln_scale"], e["ln_bias"]).astype(cfg.compute_dtype)
+
+
+def mlm_head(params, h, cfg: TransformerConfig):
+    """MLM head with tied output embedding: [B,T,D] → logits [B,T,V] fp32."""
+    m = params["mlm"]
+    cd = cfg.compute_dtype
+    x = jax.nn.gelu(h.astype(cd) @ m["w"].astype(cd) + m["b"].astype(cd),
+                    approximate=cfg.gelu_approximate)
+    x = _layer_norm(x, m["ln_scale"], m["ln_bias"])
+    logits = x.astype(jnp.float32) @ params["embed"]["tok"].astype(jnp.float32).T
+    return logits + m["out_bias"].astype(jnp.float32)
+
+
+def token_ce_loss(logits, labels, weights=None):
+    """Weighted token cross-entropy (masked-LM and causal-LM alike)."""
+    if weights is None:
+        weights = jnp.ones(labels.shape, jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.sum((logz - gold) * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+def forward(params, tokens, cfg: TransformerConfig, *, segments=None, pad_mask=None,
+            rng=None, train: bool = False):
+    """tokens [B,T] int32 → logits [B,T,V] (float32)."""
+    h = embed(params, tokens, cfg, segments=segments)
 
     block = functools.partial(_block, cfg)
     if cfg.remat:
@@ -244,14 +269,7 @@ def forward(params, tokens, cfg: TransformerConfig, *, segments=None, pad_mask=N
         sub = jax.random.fold_in(rng, i) if rng is not None else None
         h = block(p, h, pad_mask, sub, train)
 
-    m = params["mlm"]
-    x = jax.nn.gelu(h.astype(cfg.compute_dtype) @ m["w"].astype(cfg.compute_dtype)
-                    + m["b"].astype(cfg.compute_dtype),
-                    approximate=cfg.gelu_approximate)
-    x = _layer_norm(x, m["ln_scale"], m["ln_bias"])
-    # tied output embedding (BERT MLM head)
-    logits = x.astype(jnp.float32) @ params["embed"]["tok"].astype(jnp.float32).T
-    return logits + m["out_bias"].astype(jnp.float32)
+    return mlm_head(params, h, cfg)
 
 
 def loss_fn(params, batch, cfg: TransformerConfig, rng=None, train: bool = True):
@@ -259,14 +277,7 @@ def loss_fn(params, batch, cfg: TransformerConfig, rng=None, train: bool = True)
     positions) and causal-LM (weights = all positions) alike."""
     logits = forward(params, batch["tokens"], cfg, segments=batch.get("segments"),
                      pad_mask=batch.get("pad_mask"), rng=rng, train=train)
-    labels = batch["labels"]
-    w = batch.get("weights")
-    if w is None:
-        w = jnp.ones(labels.shape, jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    nll = (logz - gold) * w
-    return jnp.sum(nll) / jnp.maximum(jnp.sum(w), 1.0)
+    return token_ce_loss(logits, batch["labels"], batch.get("weights"))
 
 
 def make_train_step(cfg: TransformerConfig, updater):

@@ -1,0 +1,227 @@
+"""Pipeline parallelism (GPipe-style) over a ``pipe`` mesh axis.
+
+Reference inversion (SURVEY §2.10 PP row): the reference has NO pipeline
+parallelism — its distribution story is data-parallel only. The modern-set
+mandate is covered here the TPU way: stages are a *sharded leading dim* of a
+stacked param tree, the microbatch loop is a ``lax.scan`` inside
+``shard_map``, and inter-stage activation transfer is a single
+``lax.ppermute`` ring hop per tick — i.e. the schedule compiles into one XLA
+program, no host-side stage threads (the reference's analogous machinery
+would have been Aeron queues between JVM workers).
+
+Design notes:
+- GPipe fill-drain schedule: ``M`` microbatches over ``S`` stages takes
+  ``M + S - 1`` ticks; bubble fraction = (S-1)/(M+S-1).
+- Every stage must map activations of one shape to the same shape (true for
+  transformer blocks / residual stacks). Embedding + head run OUTSIDE the
+  pipeline body (they are cheap; GSPMD shards them over dp).
+- Backward is automatic: ``ppermute``'s transpose is the reverse ring hop, so
+  ``jax.grad`` through :func:`spmd_pipeline` yields exactly the 1F1B-ish
+  reverse schedule XLA can overlap.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DATA, AXIS_PIPE
+
+
+def _squeeze_leading(tree):
+    return jax.tree.map(lambda x: jnp.squeeze(x, 0) if x.ndim > 0 and x.shape[0] == 1 else x, tree)
+
+
+def _pipeline_body(stage_fn, params_local, xs, aux, axis: str):
+    """Runs on each pipe-shard: params_local has leading dim n_stages/S==1.
+
+    xs: [M, mb, ...] microbatches (pipe-replicated). aux: optional pytree of
+    per-microbatch side inputs [M, ...] that do NOT flow through the ring
+    (masks, segment ids): at tick t, stage s is working on microbatch
+    (t - s), so each stage indexes its own aux slice. Returns ys [M, mb, ...]
+    (pipe-replicated — the last stage's results psum-broadcast over the axis).
+    """
+    n_stages = jax.lax.psum(1, axis)
+    stage = jax.lax.axis_index(axis)
+    my_params = _squeeze_leading(params_local)
+    M = xs.shape[0]
+    total = M + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage s works on microbatch (t - s); clamp covers warm-up/drain
+        # ticks whose results are never recorded
+        mb_idx = jnp.clip(t - stage, 0, M - 1)
+        inp = jnp.where(stage == 0, xs[jnp.minimum(t, M - 1)], state)
+        aux_t = jax.tree.map(lambda a: a[mb_idx], aux) if aux is not None else None
+        out = stage_fn(my_params, inp, aux_t) if aux is not None else stage_fn(my_params, inp)
+        # last stage records microbatch (t - S + 1) once it exists; the
+        # explicit validity gate (not index arithmetic) keeps warm-up ticks
+        # from writing anything
+        idx = jnp.maximum(t - (n_stages - 1), 0)
+        written = outputs.at[idx].set(out)
+        valid = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        outputs = jnp.where(valid, written, outputs)
+        state = jax.lax.ppermute(out, axis, perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros_like(xs[0])
+    outputs0 = jnp.zeros_like(xs)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0), jnp.arange(total))
+    # broadcast the last stage's outputs to every pipe shard (sum of one
+    # valid contribution + zeros); differentiable, unlike a host-side gather
+    return jax.lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)), axis)
+
+
+def resolve_data_axis(mesh: Mesh, data_axis) -> Optional[str]:
+    """'auto' picks the canonical batch axis present in the mesh ('data' or
+    'dp'); an explicit axis missing from the mesh is an error (a silent miss
+    would replicate the batch and quietly disable data parallelism)."""
+    if data_axis == "auto":
+        for cand in (AXIS_DATA, "dp"):
+            if cand in mesh.shape:
+                return cand
+        return None
+    if data_axis is not None and data_axis not in mesh.shape:
+        raise ValueError(f"data_axis '{data_axis}' not in mesh axes {tuple(mesh.shape)}")
+    return data_axis
+
+
+def spmd_pipeline(stage_fn: Callable[..., Any], stacked_params, xs, mesh: Mesh,
+                  *, pipe_axis: str = AXIS_PIPE, data_axis="auto", aux=None):
+    """GPipe the microbatches ``xs`` through ``n_stages = mesh.shape[pipe_axis]``.
+
+    - ``stacked_params``: pytree whose every leaf has leading dim ``n_stages``
+      (stage i's slice is its stage-local params), sharded over ``pipe_axis``.
+    - ``xs``: [M, mb, ...] microbatched activations. The microbatch dim M is
+      never sharded; the per-microbatch batch dim may be sharded over
+      ``data_axis`` (pp×dp composes). ``data_axis='auto'`` uses whichever of
+      'data'/'dp' the mesh has.
+    - ``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape`` — or
+      ``stage_fn(stage_params, x, aux_mb)`` when ``aux`` (a pytree of
+      [M, ...] per-microbatch side inputs, e.g. attention masks) is given.
+    """
+    if pipe_axis not in mesh.shape:
+        raise ValueError(f"mesh has no '{pipe_axis}' axis: {mesh.shape}")
+    dp = resolve_data_axis(mesh, data_axis)
+    pspec = jax.tree.map(lambda x: P(pipe_axis, *([None] * (x.ndim - 1))), stacked_params)
+    xspec = P(None, dp, *([None] * (xs.ndim - 2)))
+    aspec = (None if aux is None
+             else jax.tree.map(lambda a: P(None, dp, *([None] * (a.ndim - 2))), aux))
+    f = jax.shard_map(
+        functools.partial(_pipeline_body, stage_fn, axis=pipe_axis),
+        mesh=mesh, in_specs=(pspec, xspec, aspec), out_specs=xspec,
+        check_vma=False,
+    )
+    return f(stacked_params, xs, aux)
+
+
+def microbatch(x, n_microbatches: int):
+    """[B, ...] -> [M, B/M, ...] (static split; B must divide)."""
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
+    return x.reshape(n_microbatches, B // n_microbatches, *x.shape[1:])
+
+
+def unmicrobatch(x):
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
+# --------------------------------------------------------- transformer wiring
+
+
+def stack_blocks(block_list):
+    """List of per-layer param dicts -> stacked tree with leading layer dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *block_list)
+
+
+def unstack_blocks(stacked, n_layers: int):
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n_layers)]
+
+
+def pipeline_transformer_params(params, n_stages: int):
+    """Convert models.transformer init_params output to the PP layout:
+    blocks stacked [S, L/S, ...]; embed/mlm untouched."""
+    blocks = params["blocks"]
+    L = len(blocks)
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible into {n_stages} stages")
+    stacked = stack_blocks(blocks)  # [L, ...]
+    staged = jax.tree.map(
+        lambda x: x.reshape(n_stages, L // n_stages, *x.shape[1:]), stacked)
+    return {"embed": params["embed"], "blocks": staged, "mlm": params["mlm"]}
+
+
+def pipeline_partition_specs(params_pp, *, pipe_axis: str = AXIS_PIPE):
+    """Specs for the PP layout: blocks sharded on the stage dim, embed/mlm
+    replicated (GSPMD still dp-shards their compute via the batch)."""
+    return {
+        "embed": jax.tree.map(lambda _: P(), params_pp["embed"]),
+        "blocks": jax.tree.map(
+            lambda x: P(pipe_axis, *([None] * (x.ndim - 1))), params_pp["blocks"]),
+        "mlm": jax.tree.map(lambda _: P(), params_pp["mlm"]),
+    }
+
+
+def transformer_pp_loss_fn(cfg, n_microbatches: int, mesh: Mesh,
+                           *, pipe_axis: str = AXIS_PIPE, data_axis="auto"):
+    """Build loss(params_pp, batch) running blocks through the GPipe schedule.
+
+    Embedding and the MLM head run outside the pipeline body (dp-sharded by
+    GSPMD) via the same ``models.transformer`` helpers the single-device path
+    uses; the stacked blocks run inside shard_map with pad_mask traveling as
+    a per-microbatch aux input. Deterministic (no dropout) — PP training v1
+    matches the reference's inference-mode parity bar; dropout needs
+    per-stage rng plumbing (future work).
+    """
+    from ..models import transformer as T
+
+    def stage_fn(stage_blocks, h, pad_mask):
+        # stage_blocks: [L/S, ...] — scan over the in-stage layers
+        def body(carry, blk):
+            return T._block(cfg, blk, carry, pad_mask, None, False), None
+
+        out, _ = jax.lax.scan(body, h, stage_blocks)
+        return out
+
+    def loss(params_pp, batch):
+        h = T.embed(params_pp, batch["tokens"], cfg, segments=batch.get("segments"))
+        xs = microbatch(h, n_microbatches)
+        pm = batch.get("pad_mask")
+        aux = None if pm is None else microbatch(pm, n_microbatches)
+        if aux is None:
+            ys = spmd_pipeline(lambda p, x: stage_fn(p, x, None), params_pp["blocks"],
+                               xs, mesh, pipe_axis=pipe_axis, data_axis=data_axis)
+        else:
+            ys = spmd_pipeline(stage_fn, params_pp["blocks"], xs, mesh,
+                               pipe_axis=pipe_axis, data_axis=data_axis, aux=aux)
+        h = unmicrobatch(ys)
+        logits = T.mlm_head(params_pp, h, cfg)
+        return T.token_ce_loss(logits, batch["labels"], batch.get("weights"))
+
+    return loss
+
+
+def make_pp_train_step(cfg, updater, n_microbatches: int, mesh: Mesh,
+                       *, pipe_axis: str = AXIS_PIPE, data_axis="auto"):
+    """Full PP train step: pipeline loss + grads + updater + apply. Grads of
+    the stacked blocks land sharded over the pipe axis (each stage's HBM only
+    holds its own layers + optimizer state — the PP memory win)."""
+    loss_fn = transformer_pp_loss_fn(cfg, n_microbatches, mesh,
+                                     pipe_axis=pipe_axis, data_axis=data_axis)
+
+    def step(params_pp, opt_state, batch, iteration):
+        loss, grads = jax.value_and_grad(loss_fn)(params_pp, batch)
+        updates, new_opt = updater.apply(grads, opt_state, params_pp, iteration, 0)
+        new_params = jax.tree.map(lambda p, u: (p - u).astype(p.dtype), params_pp, updates)
+        return new_params, new_opt, loss
+
+    return step

@@ -70,9 +70,25 @@ class ShardingRules:
 
     def shard_tree(self, params, mesh: Mesh):
         specs = self.spec_tree(params)
+        # divisibility guard: a dim not divisible by its mesh axis (e.g. a
+        # 2-class output head over model=4) silently falls back to replicated
+        # — the same "shard what fits" behavior GSPMD applies to activations
+        specs = jax.tree.map(
+            lambda w, s: s if _divisible(w, s, mesh) else P(),
+            params, specs, is_leaf=lambda x: isinstance(x, P))
         shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                                  is_leaf=lambda x: isinstance(x, P))
         return jax.device_put(params, shardings), specs
+
+
+def _divisible(w, spec: P, mesh: Mesh) -> bool:
+    for dim, axes in enumerate(spec):
+        if axes is None:
+            continue
+        for ax in (axes if isinstance(axes, tuple) else (axes,)):
+            if w.shape[dim] % mesh.shape[ax] != 0:
+                return False
+    return True
 
 
 def alternating_dense_rules(model_axis: str = AXIS_MODEL) -> ShardingRules:

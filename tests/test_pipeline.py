@@ -1,0 +1,244 @@
+"""Pipeline parallelism (GPipe) + tensor-parallel fit wiring.
+
+SURVEY §2.10 PP/TP rows: loss-equality of the stage-sharded shard_map
+pipeline vs plain single-device execution, and TP-vs-replicated numerical
+equality through the standard MultiLayerNetwork fit path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    make_train_step,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel.pipeline import (
+    make_pp_train_step,
+    microbatch,
+    pipeline_partition_specs,
+    pipeline_transformer_params,
+    spmd_pipeline,
+    transformer_pp_loss_fn,
+    unmicrobatch,
+)
+
+
+def _cfg(n_layers=4):
+    return TransformerConfig(
+        vocab_size=64, max_len=32, d_model=16, n_heads=2, n_layers=n_layers,
+        d_ff=32, dropout=0.0, param_dtype=jnp.float32, compute_dtype=jnp.float32,
+    )
+
+
+def _batch(cfg, B=8, T=16, seed=0):
+    rs = np.random.RandomState(seed)
+    return {
+        "tokens": jnp.asarray(rs.randint(0, cfg.vocab_size, (B, T)), jnp.int32),
+        "labels": jnp.asarray(rs.randint(0, cfg.vocab_size, (B, T)), jnp.int32),
+        "weights": jnp.ones((B, T), jnp.float32),
+    }
+
+
+def _pp_mesh(dp=2, pipe=4):
+    devs = np.array(jax.devices()[: dp * pipe]).reshape(dp, pipe)
+    return Mesh(devs, ("dp", "pipe"))
+
+
+class TestSpmdPipeline:
+    def test_generic_pipeline_matches_sequential(self):
+        """4-stage elementwise affine stages == sequential composition."""
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("pipe",))
+        S, M, mb, D = 4, 6, 2, 8
+        rs = np.random.RandomState(1)
+        stacked = {
+            "w": jnp.asarray(rs.randn(S, D).astype(np.float32)),
+            "b": jnp.asarray(rs.randn(S, D).astype(np.float32)),
+        }
+        xs = jnp.asarray(rs.randn(M, mb, D).astype(np.float32))
+
+        def stage(p, x):
+            return jnp.tanh(x * p["w"] + p["b"])
+
+        got = spmd_pipeline(stage, stacked, xs, mesh, data_axis=None)
+        want = xs
+        for s in range(S):
+            want = jax.vmap(lambda x: stage({"w": stacked["w"][s], "b": stacked["b"][s]}, x))(want)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+    def test_pipeline_grads_match_sequential(self):
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("pipe",))
+        S, M, mb, D = 4, 4, 2, 8
+        rs = np.random.RandomState(2)
+        stacked = {"w": jnp.asarray(rs.randn(S, D).astype(np.float32))}
+        xs = jnp.asarray(rs.randn(M, mb, D).astype(np.float32))
+
+        def stage(p, x):
+            return jnp.tanh(x * p["w"])
+
+        def pp_loss(params):
+            return jnp.sum(spmd_pipeline(stage, params, xs, mesh, data_axis=None) ** 2)
+
+        def seq_loss(params):
+            h = xs
+            for s in range(S):
+                h = jnp.tanh(h * params["w"][s])
+            return jnp.sum(h ** 2)
+
+        g_pp = jax.grad(pp_loss)(stacked)
+        g_seq = jax.grad(seq_loss)(stacked)
+        np.testing.assert_allclose(np.asarray(g_pp["w"]), np.asarray(g_seq["w"]),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_transformer_pp_loss_matches_single_device(self):
+        cfg = _cfg(n_layers=4)
+        params = init_params(jax.random.key(0), cfg)
+        batch = _batch(cfg)
+        want = float(loss_fn(params, batch, cfg, rng=None, train=False))
+
+        mesh = _pp_mesh(dp=2, pipe=4)
+        pp_params = pipeline_transformer_params(params, n_stages=4)
+        specs = pipeline_partition_specs(pp_params)
+        pp_params = jax.device_put(
+            pp_params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                    is_leaf=lambda x: isinstance(x, P)))
+        ppl = transformer_pp_loss_fn(cfg, n_microbatches=4, mesh=mesh)
+        got = float(jax.jit(ppl)(pp_params, batch))
+        assert abs(got - want) < 1e-5, (got, want)
+
+    def test_transformer_pp_train_step_matches_single_device(self):
+        cfg = _cfg(n_layers=4)
+        params = init_params(jax.random.key(0), cfg)
+        batch = _batch(cfg)
+
+        # single-device baseline, dropout off / train=False parity path
+        upd = Sgd(0.1)
+        base_params = jax.tree.map(jnp.copy, params)
+
+        def base_loss(p, b):
+            return loss_fn(p, b, cfg, rng=None, train=False)
+
+        @jax.jit
+        def base_step(p, b):
+            l, g = jax.value_and_grad(base_loss)(p, b)
+            u, _ = upd.apply(g, {}, p, 0, 0)
+            return jax.tree.map(lambda x, y: x - y, p, u), l
+
+        mesh = _pp_mesh(dp=2, pipe=4)
+        pp_params = pipeline_transformer_params(params, n_stages=4)
+        specs = pipeline_partition_specs(pp_params)
+        pp_params = jax.device_put(
+            pp_params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                    is_leaf=lambda x: isinstance(x, P)))
+        opt_state = upd.init(pp_params)
+        pp_step = jax.jit(make_pp_train_step(cfg, upd, n_microbatches=4, mesh=mesh))
+
+        losses_base, losses_pp = [], []
+        for i in range(3):
+            b = _batch(cfg, seed=i)
+            base_params, l0 = base_step(base_params, b)
+            pp_params, opt_state, l1 = pp_step(pp_params, opt_state, b, jnp.asarray(i))
+            losses_base.append(float(l0))
+            losses_pp.append(float(l1))
+        np.testing.assert_allclose(losses_pp, losses_base, rtol=1e-4, atol=1e-5)
+        # stacked blocks shard over pipe: each stage holds only its layers
+        leaf = jax.tree.leaves(pp_params["blocks"])[0]
+        assert "pipe" in leaf.sharding.spec
+
+    def test_transformer_pp_respects_pad_mask_and_segments(self):
+        """pad_mask/segments flow through the pipeline as aux inputs and
+        match the single-device loss exactly."""
+        cfg = _cfg(n_layers=4)
+        params = init_params(jax.random.key(3), cfg)
+        batch = _batch(cfg)
+        rs = np.random.RandomState(9)
+        batch["pad_mask"] = jnp.asarray(
+            (rs.rand(8, 16) > 0.25).astype(np.float32))
+        batch["segments"] = jnp.asarray(rs.randint(0, 2, (8, 16)), jnp.int32)
+        want = float(loss_fn(params, batch, cfg, rng=None, train=False))
+
+        mesh = _pp_mesh(dp=2, pipe=4)
+        pp_params = pipeline_transformer_params(params, n_stages=4)
+        specs = pipeline_partition_specs(pp_params)
+        pp_params = jax.device_put(
+            pp_params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                    is_leaf=lambda x: isinstance(x, P)))
+        ppl = transformer_pp_loss_fn(cfg, n_microbatches=4, mesh=mesh)
+        got = float(jax.jit(ppl)(pp_params, batch))
+        assert abs(got - want) < 1e-5, (got, want)
+
+    def test_data_axis_mismatch_raises(self):
+        from deeplearning4j_tpu.parallel.pipeline import resolve_data_axis
+
+        mesh = _pp_mesh(dp=2, pipe=4)
+        assert resolve_data_axis(mesh, "auto") == "dp"
+        with pytest.raises(ValueError):
+            resolve_data_axis(mesh, "data")
+
+    def test_microbatch_roundtrip(self):
+        x = jnp.arange(24.0).reshape(8, 3)
+        assert np.array_equal(np.asarray(unmicrobatch(microbatch(x, 4))), np.asarray(x))
+        with pytest.raises(ValueError):
+            microbatch(x, 3)
+
+
+class TestTensorParallelFit:
+    def test_tp_fit_matches_replicated(self):
+        """MLN fit through ParallelTrainer with Megatron alternating rules ==
+        plain single-device fit (GSPMD collectives are numerically exact)."""
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer,
+            InputType,
+            NeuralNetConfiguration,
+            OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+        from deeplearning4j_tpu.parallel.sharding import alternating_dense_rules
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+        def build():
+            return (
+                NeuralNetConfiguration.Builder()
+                .seed(7)
+                .updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_in=16, n_out=32, activation="relu"))
+                .layer(DenseLayer(n_in=32, n_out=32, activation="relu"))
+                .layer(OutputLayer(n_in=32, n_out=4))
+                .set_input_type(InputType.feed_forward(16))
+                .build()
+            )
+
+        rs = np.random.RandomState(3)
+        batches = []
+        for i in range(4):
+            x = rs.randn(8, 16).astype(np.float32)
+            y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 8)]
+            batches.append(DataSet(x, y))
+
+        base = MultiLayerNetwork(build()).init()
+        for ds in batches:
+            base._fit_batch(ds)
+
+        tp = MultiLayerNetwork(build()).init()
+        mesh = build_mesh(data=2, model=4)
+        trainer = ParallelTrainer(tp, mesh, sharding_rules=alternating_dense_rules())
+        trainer.fit(ListDataSetIterator(batches, batch_size=8))
+
+        # TP params actually sharded on the model axis
+        w0 = tp.params_["0"]["W"]
+        assert "model" in str(w0.sharding.spec)
+        for k in base.params_:
+            for name in base.params_[k]:
+                np.testing.assert_allclose(
+                    np.asarray(tp.params_[k][name]), np.asarray(base.params_[k][name]),
+                    rtol=2e-5, atol=2e-5,
+                    err_msg=f"param {k}/{name} diverged under TP")
