@@ -184,6 +184,13 @@ def transformer_pp_loss_fn(cfg, n_microbatches: int, mesh: Mesh,
     """
     from ..models import transformer as T
 
+    if cfg.dropout and cfg.dropout > 0.0:
+        raise ValueError(
+            "pipeline-parallel training runs deterministic (per-stage dropout "
+            "rng plumbing not implemented); set cfg.dropout=0.0 explicitly — "
+            "silently dropping regularization would diverge from the "
+            "single-device path")
+
     def stage_fn(stage_blocks, h, pad_mask):
         # stage_blocks: [L/S, ...] — scan over the in-stage layers
         def body(carry, blk):

@@ -171,6 +171,15 @@ class MultiProcessTrainer(ParallelTrainer):
     (SURVEY §3.4 'TPU mapping').
     """
 
+    def __init__(self, net, mesh: Optional[Mesh] = None, data_axis: str = AXIS_DATA,
+                 sharding_rules=None):
+        if sharding_rules is not None:
+            raise NotImplementedError(
+                "sharding_rules placement uses jax.device_put, which cannot "
+                "address a multi-process mesh; multi-process TP needs "
+                "make_array_from_process_local_data per-shard construction")
+        super().__init__(net, mesh, data_axis)
+
     def _fit_batch(self, ds: DataSet):
         # the single-process remainder fallback cannot cross process
         # boundaries (it would mix global params with per-process inputs), so
