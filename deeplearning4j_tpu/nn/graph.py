@@ -21,10 +21,10 @@ from ..eval.evaluation import Evaluation
 from ..ndarray.ndarray import NDArray
 from .conf import BatchNormalization, GlobalPoolingLayer, LastTimeStep, LSTM, GravesLSTM
 from .graph_conf import ComputationGraphConfiguration
-from .multilayer import _grad_normalize, _mask_frozen
+from .multilayer import _grad_normalize, _mask_frozen, _LazyScoreMixin
 
 
-class ComputationGraph:
+class ComputationGraph(_LazyScoreMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.params_: Dict[str, Any] = {}
@@ -225,7 +225,7 @@ class ComputationGraph:
             jnp.asarray(self.iteration, jnp.int32), jnp.asarray(self.epoch, jnp.int32),
             inputs, labels, lmasks, rng,
         )
-        self.score_ = float(loss)
+        self.score_ = loss  # lazy: syncs only when read
         self.iteration += 1
         for lst in self.listeners:
             if hasattr(lst, "iteration_done"):

@@ -144,7 +144,7 @@ class ParallelTrainer:
                 n.params_, n.updater_state, n.bn_state,
                 jnp.asarray(n.iteration, jnp.int32), jnp.asarray(n.epoch, jnp.int32),
                 inputs, labels, lmasks, rng)
-            n.score_ = float(loss)
+            n.score_ = loss  # lazy: syncs only when read
             n.iteration += 1
             for lst in n.listeners:
                 if hasattr(lst, "iteration_done"):
