@@ -1,0 +1,7 @@
+"""Keras model import (reference: ``deeplearning4j-modelimport``
+``org.deeplearning4j.nn.modelimport.keras.KerasModelImport`` — SURVEY §2.4
+C13)."""
+
+from .keras_import import KerasModelImport
+
+__all__ = ["KerasModelImport"]

@@ -1,0 +1,471 @@
+"""Keras HDF5 import → MultiLayerNetwork / ComputationGraph.
+
+Reference: ``deeplearning4j-modelimport`` ``KerasModelImport`` /
+``KerasModel`` / per-layer ``KerasLayer`` mappers (~35k LoC Java + JavaCPP
+HDF5; SURVEY §2.4 C13). This is the TPU-native equivalent: h5py + json only
+— keras/tensorflow are NOT imported (they exist in tests solely to generate
+golden fixtures), mirroring the reference's ability to load Keras files
+without Keras installed.
+
+Supported (the DL4J-parity subset): Sequential and Functional models saved
+as legacy HDF5 (``model.save("m.h5")``) with layers Dense, Conv2D,
+MaxPooling2D, AveragePooling2D, GlobalMax/AveragePooling2D, Flatten,
+Dropout, Activation, BatchNormalization, LSTM, and (functional) Add /
+Concatenate. The ``.keras`` v3 zip stores weights under position-derived
+paths with no robust name keying — convert with ``model.save("m.h5")``.
+
+Layout conversions (the part the reference spends most of its mapper code
+on):
+- images: Keras is channels_last (NHWC); this framework's public layout is
+  NCHW (DL4J parity) — imported nets take NCHW input, conv kernels move
+  HWIO→OIHW, and the first Dense after a Flatten gets its kernel rows
+  permuted from (h,w,c) to (c,h,w) flattening order.
+- sequences: Keras is [B,T,F]; here [B,F,T] (DL4J NCT). LSTM kernels are
+  re-chunked from Keras gate order IFCO to this framework's IFOG.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..nn.conf import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    GlobalPoolingLayer,
+    InputType,
+    LastTimeStep,
+    LSTM,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from ..nn.graph_conf import ElementWiseVertex, MergeVertex
+
+_ACT = {"linear": "identity", None: "identity"}
+
+
+def _act(name: Optional[str]) -> str:
+    return _ACT.get(name, name or "identity")
+
+
+class KerasImportError(ValueError):
+    """Unsupported file / layer (KerasLayer's InvalidKerasConfigurationException)."""
+
+
+# ----------------------------------------------------------------- h5 loading
+
+
+def _load_h5(path: str) -> Tuple[dict, Dict[str, Dict[str, np.ndarray]]]:
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        if "model_config" not in f.attrs:
+            raise KerasImportError(
+                f"{path}: no model_config attribute — not a Keras full-model "
+                "HDF5 file (note: .keras v3 zips are unsupported; re-save "
+                "with model.save('model.h5'))")
+        raw = f.attrs["model_config"]
+        cfg = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+        weights: Dict[str, Dict[str, np.ndarray]] = {}
+        mw = f["model_weights"]
+        for lname in mw:
+            grp = mw[lname]
+            names = [n.decode() if isinstance(n, bytes) else n
+                     for n in grp.attrs.get("weight_names", [])]
+            if not names:
+                continue
+            # key by basename; keras-2/tf.keras names carry a ':0' suffix
+            weights[lname] = {
+                n.rsplit("/", 1)[-1].split(":")[0]: np.asarray(grp[n]) for n in names}
+    return cfg, weights
+
+
+# ------------------------------------------------------------- weight mappers
+
+
+def _lstm_gate_reorder(k: np.ndarray) -> np.ndarray:
+    """Keras gate chunks [i, f, c(cell), o] → IFOG [i, f, o, g]."""
+    i, f, c, o = np.split(k, 4, axis=-1)
+    return np.concatenate([i, f, o, c], axis=-1)
+
+
+def _flatten_row_perm(h: int, w: int, c: int) -> np.ndarray:
+    """Row permutation for a Dense kernel following Flatten: Keras flattens
+    NHWC as (h,w,c); this framework flattens NCHW as (c,h,w)."""
+    return np.arange(h * w * c).reshape(h, w, c).transpose(2, 0, 1).ravel()
+
+
+def _conv_params(w):
+    p = {"W": w["kernel"].transpose(3, 2, 0, 1)}  # HWIO → OIHW
+    if "bias" in w:
+        p["b"] = w["bias"]
+    return p
+
+
+def _dense_params(w, row_perm=None):
+    k = w["kernel"]
+    if row_perm is not None:
+        k = k[row_perm]
+    p = {"W": k}
+    if "bias" in w:
+        p["b"] = w["bias"]
+    return p
+
+
+def _lstm_params(w):
+    return {
+        "W": _lstm_gate_reorder(w["kernel"]),
+        "RW": _lstm_gate_reorder(w["recurrent_kernel"]),
+        "b": _lstm_gate_reorder(w["bias"]) if "bias" in w else None,
+    }
+
+
+def _bn_params_state(w):
+    return ({"gamma": w["gamma"], "beta": w["beta"]},
+            {"mean": w["moving_mean"], "var": w["moving_variance"]})
+
+
+# ------------------------------------------------------------- layer mapping
+
+
+def _pool2(v, default=None):
+    if v is None:
+        return default
+    if isinstance(v, int):
+        return (v, v)
+    return tuple(v)
+
+
+def _batch_shape(lcfg: dict):
+    """Keras 3 calls it batch_shape; Keras 2 / tf.keras batch_input_shape."""
+    return lcfg.get("batch_shape") or lcfg.get("batch_input_shape")
+
+
+def _input_type_from_shape(shape) -> InputType:
+    dims = [d for d in shape[1:]]
+    if len(dims) == 3:
+        return InputType.convolutional(dims[0], dims[1], dims[2])  # keras NHWC
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])  # keras [T,F] → (size, T)
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    raise KerasImportError(f"unsupported input shape {shape}")
+
+
+class _Ctx:
+    """Per-model mapping state (the role of DL4J's KerasModel fields)."""
+
+    def __init__(self):
+        self.flatten_from: Optional[Tuple[int, int, int]] = None  # (h,w,c)
+
+
+def _map_layer(cls: str, cfg: dict, w: Optional[dict], ctx: _Ctx, it: InputType,
+               is_output: bool):
+    """Returns (layers, params_list, bn_state_or_None) — one keras layer can
+    expand to up to two framework layers (LSTM + LastTimeStep)."""
+    if cls == "Dense":
+        perm = None
+        if ctx.flatten_from is not None:
+            perm = _flatten_row_perm(*ctx.flatten_from)
+            ctx.flatten_from = None
+        units = cfg["units"]
+        a = _act(cfg.get("activation"))
+        common = dict(n_out=units, activation=a, has_bias=cfg.get("use_bias", True))
+        if is_output:
+            loss = "mcxent" if a == "softmax" else ("xent" if a == "sigmoid" else "mse")
+            layer = OutputLayer(loss=loss, **common)
+        else:
+            layer = DenseLayer(**common)
+        return [layer], [_dense_params(w, perm)], None
+    if cls == "Conv2D":
+        layer = ConvolutionLayer(
+            n_out=cfg["filters"],
+            kernel_size=_pool2(cfg["kernel_size"]),
+            stride=_pool2(cfg.get("strides", (1, 1))),
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True),
+        )
+        return [layer], [_conv_params(w)], None
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        ps = _pool2(cfg.get("pool_size", (2, 2)))
+        layer = SubsamplingLayer(
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            kernel_size=ps,
+            stride=_pool2(cfg.get("strides"), ps),
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
+        )
+        return [layer], [None], None
+    if cls in ("GlobalMaxPooling2D", "GlobalAveragePooling2D"):
+        layer = GlobalPoolingLayer(pooling_type="max" if "Max" in cls else "avg")
+        return [layer], [None], None
+    if cls == "Flatten":
+        # no runtime layer: the framework auto-infers CnnToFeedForward; we
+        # only record the NHWC shape for the next Dense kernel's row perm
+        if it.kind == "cnn":
+            ctx.flatten_from = (it.height, it.width, it.channels)
+        return [], [], None
+    if cls == "Dropout":
+        return [DropoutLayer(dropout=1.0 - cfg["rate"])], [None], None
+    if cls == "Activation":
+        return [ActivationLayer(activation=_act(cfg.get("activation")))], [None], None
+    if cls == "BatchNormalization":
+        if cfg.get("axis") not in (None, -1, [-1], 3, [3], 1, [1]):
+            raise KerasImportError(f"BatchNormalization axis {cfg.get('axis')} unsupported")
+        p, state = _bn_params_state(w)
+        layer = BatchNormalization(decay=cfg.get("momentum", 0.99),
+                                   eps=cfg.get("epsilon", 1e-3))
+        return [layer], [p], state
+    if cls == "LSTM":
+        lp = _lstm_params(w)
+        layer = LSTM(n_in=lp["W"].shape[0], n_out=cfg["units"],
+                     activation=_act(cfg.get("activation", "tanh")),
+                     gate_activation=_act(cfg.get("recurrent_activation", "sigmoid")))
+        if lp["b"] is None:
+            lp["b"] = np.zeros(4 * cfg["units"], np.float32)
+        layers = [layer]
+        params = [lp]
+        if not cfg.get("return_sequences", False):
+            layers.append(LastTimeStep())
+            params.append(None)
+        return layers, params, None
+    raise KerasImportError(f"unsupported Keras layer {cls} "
+                           f"(KerasModelImport subset — SURVEY §2.4 C13)")
+
+
+# --------------------------------------------------------------- public API
+
+
+class KerasModelImport:
+    """``org.deeplearning4j.nn.modelimport.keras.KerasModelImport`` parity."""
+
+    @staticmethod
+    def import_model(path: str):
+        """Auto-detect Sequential → MultiLayerNetwork, Functional →
+        ComputationGraph (KerasModelImport.importKerasModelAndWeights)."""
+        cfg, weights = _load_h5(path)
+        if cfg["class_name"] == "Sequential":
+            return KerasModelImport._import_sequential(cfg, weights)
+        if cfg["class_name"] in ("Functional", "Model"):
+            return KerasModelImport._import_functional(cfg, weights)
+        raise KerasImportError(f"unsupported model class {cfg['class_name']}")
+
+    importKerasModelAndWeights = import_model
+
+    @staticmethod
+    def import_sequential(path: str):
+        cfg, weights = _load_h5(path)
+        if cfg["class_name"] != "Sequential":
+            raise KerasImportError(f"{path} is a {cfg['class_name']}, not Sequential")
+        return KerasModelImport._import_sequential(cfg, weights)
+
+    importKerasSequentialModelAndWeights = import_sequential
+
+    # ------------------------------------------------------------- internals
+
+    @staticmethod
+    def _import_sequential(cfg: dict, weights):
+        from ..nn.multilayer import MultiLayerNetwork
+
+        mconf = cfg["config"]
+        klayers = mconf if isinstance(mconf, list) else mconf["layers"]
+        if not klayers:
+            raise KerasImportError("empty Sequential model")
+        if klayers[0]["class_name"] == "InputLayer":
+            it = _input_type_from_shape(_batch_shape(klayers[0]["config"]))
+            body = list(klayers[1:])
+        elif _batch_shape(klayers[0]["config"]):
+            # keras-2 style: first real layer carries batch_input_shape
+            it = _input_type_from_shape(_batch_shape(klayers[0]["config"]))
+            body = list(klayers)
+        else:
+            raise KerasImportError("Sequential model without input shape "
+                                   "(build/compile the model before saving)")
+        ctx = _Ctx()
+        builder = NeuralNetConfiguration.Builder().list()
+        params_by_idx: Dict[str, Dict[str, np.ndarray]] = {}
+        bn_by_idx: Dict[str, Dict[str, np.ndarray]] = {}
+        cur = it
+        idx = 0
+        # the terminal Dense becomes the OutputLayer (fit needs a loss head),
+        # but ONLY if nothing after it transforms activations except a
+        # trailing Activation (folded into it) or Dropout (inference no-op)
+        last_param_pos = -1
+        d = max((i for i, l in enumerate(body) if l["class_name"] == "Dense"),
+                default=-1)
+        if d >= 0 and all(l["class_name"] in ("Activation", "Dropout")
+                          for l in body[d + 1:]):
+            last_param_pos = d
+            for j in range(d + 1, len(body)):
+                if body[j]["class_name"] == "Activation":
+                    body[d]["config"]["activation"] = body[j]["config"]["activation"]
+                    del body[j]
+                    break
+        for i, kl in enumerate(body):
+            lname = kl["config"].get("name", kl["class_name"])
+            w = weights.get(lname)
+            layers, params, bn = _map_layer(
+                kl["class_name"], kl["config"], w, ctx, cur, is_output=(i == last_param_pos))
+            for layer, p in zip(layers, params):
+                builder.layer(layer)
+                if p:
+                    params_by_idx[str(idx)] = p
+                if bn is not None and isinstance(layer, BatchNormalization):
+                    bn_by_idx[str(idx)] = bn
+                cur = layer.output_type(cur)
+                idx += 1
+        builder.set_input_type(it)
+        net = MultiLayerNetwork(builder.build()).init()
+        _transplant(net.params_, params_by_idx)
+        _transplant(net.bn_state, bn_by_idx)
+        return net
+
+    @staticmethod
+    def _import_functional(cfg: dict, weights):
+        from ..nn.graph import ComputationGraph
+
+        conf = cfg["config"]
+
+        def names_of(spec):
+            # single node: ["name", 0, 0]; multiple: [["a",0,0], ["b",0,0]]
+            if spec and isinstance(spec[0], str):
+                return [spec[0]]
+            return [s[0] for s in spec]
+
+        inputs = names_of(conf["input_layers"])
+        outputs = names_of(conf["output_layers"])
+        gb = NeuralNetConfiguration.Builder().graph_builder()
+        gb.add_inputs(*inputs)
+        in_types = []
+        ctxs: Dict[str, _Ctx] = {}
+        params_by_name: Dict[str, Dict[str, np.ndarray]] = {}
+        bn_by_name: Dict[str, Dict[str, np.ndarray]] = {}
+        # types tracked manually so flatten perms and LastTimeStep expansion
+        # can be decided per node during the walk
+        types: Dict[str, InputType] = {}
+        flat_from: Dict[str, Optional[Tuple[int, int, int]]] = {}
+        alias_tail: Dict[str, str] = {}  # keras name → expansion tail node
+        expansion_members: set = set()
+
+        for kl in conf["layers"]:
+            cls, lcfg, name = kl["class_name"], kl["config"], kl["name"]
+            if cls == "InputLayer":
+                types[name] = _input_type_from_shape(_batch_shape(lcfg))
+                flat_from[name] = None
+                continue
+            srcs = _inbound_names(kl)
+            if cls == "Add":
+                gb.add_vertex(name, ElementWiseVertex(op="add"), *srcs)
+                types[name] = types[srcs[0]]
+                flat_from[name] = flat_from[srcs[0]]
+                continue
+            if cls == "Concatenate":
+                gb.add_vertex(name, MergeVertex(), *srcs)
+                its = [types[s] for s in srcs]
+                types[name] = MergeVertex().output_type(its)
+                flat_from[name] = None
+                continue
+            src = srcs[0]
+            ctx = _Ctx()
+            ctx.flatten_from = flat_from.get(src)
+            layers, params, bn = _map_layer(
+                cls, lcfg, weights.get(name), ctx, types[src],
+                is_output=(name in outputs and cls == "Dense"))
+            if not layers:  # Flatten
+                # pass-through node so downstream wiring stays by name
+                gb.add_vertex(name, _FlattenVertex(), *srcs)
+                it = types[src]
+                types[name] = InputType.feed_forward(it.flat_size())
+                flat_from[name] = ((it.height, it.width, it.channels)
+                                   if it.kind == "cnn" else None)
+                continue
+            node_names = [name] + [f"{name}_{j}" for j in range(1, len(layers))]
+            prev = src
+            cur = types[src]
+            for nn, layer, p in zip(node_names, layers, params):
+                gb.add_layer(nn, layer, prev)
+                if p:
+                    params_by_name[nn] = p
+                if bn is not None and isinstance(layer, BatchNormalization):
+                    bn_by_name[nn] = bn
+                cur = layer.output_type(cur)
+                prev = nn
+                flat_from[nn] = None
+            types[node_names[-1]] = cur
+            types[name] = cur  # downstream consumers look up the keras name
+            if len(layers) > 1:
+                # a keras layer that expanded (LSTM + LastTimeStep): its
+                # consumers must wire to the expansion tail
+                alias_tail[name] = node_names[-1]
+                expansion_members.update(node_names[1:])
+        # rewire consumers of expanded layers to the expansion tail (the
+        # expansion's own internal chain keeps its direct wiring)
+        for nname, node in gb._conf.nodes.items():
+            if nname in expansion_members:
+                continue
+            node.inputs = [alias_tail.get(i, i) for i in node.inputs]
+        gb.set_outputs(*[alias_tail.get(o, o) for o in outputs])
+        gb.set_input_types(*[types[i] for i in inputs])
+        net = ComputationGraph(gb.build()).init()
+        _transplant(net.params_, params_by_name)
+        _transplant(net.bn_state, bn_by_name)
+        return net
+
+
+class _FlattenVertex(ElementWiseVertex):
+    """[B,C,H,W] → [B, C*H*W] pass-through for functional Flatten nodes."""
+
+    def apply(self, inputs):
+        x = inputs[0]
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, its):
+        return InputType.feed_forward(its[0].flat_size())
+
+
+def _inbound_names(kl: dict) -> List[str]:
+    """Parse Keras-3 inbound_nodes: collect keras_history[0] from args."""
+    names: List[str] = []
+
+    def walk(o):
+        if isinstance(o, dict):
+            if o.get("class_name") == "__keras_tensor__":
+                names.append(o["config"]["keras_history"][0])
+            else:
+                for v in o.values():
+                    walk(v)
+        elif isinstance(o, (list, tuple)):
+            # keras-2 legacy node: ["layer_name", node_idx, tensor_idx(, kwargs)]
+            if (len(o) >= 3 and isinstance(o[0], str)
+                    and all(isinstance(v, int) for v in o[1:3])):
+                names.append(o[0])
+                return
+            for v in o:
+                walk(v)
+
+    for node in kl.get("inbound_nodes", []):
+        walk(node)
+    return names
+
+
+def _transplant(dst: Dict[str, Any], src: Dict[str, Dict[str, np.ndarray]]):
+    """Overwrite initialized arrays with imported ones (shape-checked)."""
+    import jax.numpy as jnp
+
+    for key, plist in src.items():
+        if key not in dst:
+            raise KerasImportError(f"imported params for unknown node {key}")
+        for pname, arr in plist.items():
+            if pname not in dst[key]:
+                raise KerasImportError(f"no param {key}/{pname} in target model")
+            want = dst[key][pname].shape
+            if tuple(arr.shape) != tuple(want):
+                raise KerasImportError(
+                    f"shape mismatch {key}/{pname}: keras {arr.shape} vs model {want}")
+            dst[key][pname] = jnp.asarray(np.asarray(arr, np.float32))
