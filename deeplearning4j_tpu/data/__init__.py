@@ -12,6 +12,19 @@ from .normalizers import (
     NormalizerMinMaxScaler,
     NormalizerStandardize,
 )
+from .image import (
+    ColorJitterTransform,
+    CropImageTransform,
+    FlipImageTransform,
+    ImageRecordReader,
+    ImageRecordReaderDataSetIterator,
+    ImageTransform,
+    ParentPathLabelGenerator,
+    PipelineImageTransform,
+    RandomCropTransform,
+    ResizeImageTransform,
+    RotateImageTransform,
+)
 from .record_reader_iterator import RecordReaderDataSetIterator
 from .records import (
     CollectionRecordReader,
@@ -23,6 +36,17 @@ from .records import (
 from .transform import Schema, TransformProcess
 
 __all__ = [
+    "ImageRecordReader",
+    "ImageRecordReaderDataSetIterator",
+    "ImageTransform",
+    "PipelineImageTransform",
+    "ParentPathLabelGenerator",
+    "ResizeImageTransform",
+    "FlipImageTransform",
+    "CropImageTransform",
+    "RandomCropTransform",
+    "RotateImageTransform",
+    "ColorJitterTransform",
     "DataSet",
     "MultiDataSet",
     "DataSetIterator",

@@ -1,0 +1,312 @@
+"""Image ETL pipeline (SURVEY §2.3 D3).
+
+Reference: ``datavec-data-image`` — ``org.datavec.image.loader.NativeImageLoader``
+(JavaCPP OpenCV decode → INDArray NCHW), ``org.datavec.image.recordreader.
+ImageRecordReader`` (directory-label extraction via ``ParentPathLabelGenerator``),
+``org.datavec.image.transform.*`` (crop/flip/rotate/warp/color augmentation,
+``PipelineImageTransform`` random chains).
+
+TPU-native shape: decode + augmentation are HOST-side numpy/PIL (the ETL
+side pillar never runs on-accelerator; the reference uses OpenCV on CPU),
+emitting NCHW float32 rows that the existing ``RecordReaderDataSetIterator``
+and ``AsyncDataSetIterator`` batch + prefetch so the compiled train step
+never waits on decode (SURVEY §3.2's async-ETL requirement).
+
+Transforms operate on HWC uint8 numpy arrays (the decode layout), chainable
+exactly like the reference's ``ImageTransform`` sequence; the reader
+converts to CHW float at the end.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dataset import DataSet
+from .iterators import DataSetIterator
+from .records import InputSplit, RecordReader
+
+_IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+
+
+# ------------------------------------------------------------ label makers
+
+
+class PathLabelGenerator:
+    """org.datavec.api.io.labels.PathLabelGenerator."""
+
+    def label_for_path(self, path: str) -> str:
+        raise NotImplementedError
+
+
+class ParentPathLabelGenerator(PathLabelGenerator):
+    """Label = name of the file's parent directory (the ImageNet/dir-per-class
+    convention the reference's examples use)."""
+
+    def label_for_path(self, path: str) -> str:
+        return os.path.basename(os.path.dirname(path))
+
+
+# -------------------------------------------------------------- transforms
+
+
+class ImageTransform:
+    """org.datavec.image.transform.ImageTransform: HWC uint8 → HWC uint8."""
+
+    def transform(self, img: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, img, rng):
+        return self.transform(img, rng)
+
+
+class ResizeImageTransform(ImageTransform):
+    def __init__(self, height: int, width: int):
+        self.height, self.width = height, width
+
+    def transform(self, img, rng):
+        from PIL import Image
+
+        return np.asarray(Image.fromarray(img).resize(
+            (self.width, self.height), Image.BILINEAR))
+
+
+class FlipImageTransform(ImageTransform):
+    """flipMode: 0 = vertical, 1 = horizontal (the OpenCV flip codes the
+    reference exposes); random=True flips with p=0.5."""
+
+    def __init__(self, flip_mode: int = 1, random: bool = True):
+        self.flip_mode = flip_mode
+        self.random = random
+
+    def transform(self, img, rng):
+        if self.random and rng.rand() >= 0.5:
+            return img
+        return img[::-1] if self.flip_mode == 0 else img[:, ::-1]
+
+
+class CropImageTransform(ImageTransform):
+    """Random crop by up to crop_[top/bottom/left/right] pixels."""
+
+    def __init__(self, crop: int = 0):
+        self.crop = crop
+
+    def transform(self, img, rng):
+        if self.crop <= 0:
+            return img
+        t, b = rng.randint(0, self.crop + 1), rng.randint(0, self.crop + 1)
+        l, r = rng.randint(0, self.crop + 1), rng.randint(0, self.crop + 1)
+        h, w = img.shape[:2]
+        return img[t:h - b or h, l:w - r or w]
+
+
+class RandomCropTransform(ImageTransform):
+    """Crop a fixed (h, w) window at a random position (ref RandomCropTransform)."""
+
+    def __init__(self, height: int, width: int):
+        self.height, self.width = height, width
+
+    def transform(self, img, rng):
+        h, w = img.shape[:2]
+        if h < self.height or w < self.width:
+            from PIL import Image
+
+            img = np.asarray(Image.fromarray(img).resize(
+                (max(w, self.width), max(h, self.height)), Image.BILINEAR))
+            h, w = img.shape[:2]
+        y = rng.randint(0, h - self.height + 1)
+        x = rng.randint(0, w - self.width + 1)
+        return img[y:y + self.height, x:x + self.width]
+
+
+class RotateImageTransform(ImageTransform):
+    """Random rotation in [-angle, angle] degrees (ref RotateImageTransform)."""
+
+    def __init__(self, angle: float):
+        self.angle = angle
+
+    def transform(self, img, rng):
+        from PIL import Image
+
+        a = rng.uniform(-self.angle, self.angle)
+        return np.asarray(Image.fromarray(img).rotate(a, Image.BILINEAR))
+
+
+class ColorJitterTransform(ImageTransform):
+    """Brightness/contrast jitter (the reference's ColorConversion/Equalize
+    family collapsed to the two augmentations modern pipelines use)."""
+
+    def __init__(self, brightness: float = 0.2, contrast: float = 0.2):
+        self.brightness, self.contrast = brightness, contrast
+
+    def transform(self, img, rng):
+        x = img.astype(np.float32)
+        x = x * (1.0 + rng.uniform(-self.contrast, self.contrast))
+        x = x + 255.0 * rng.uniform(-self.brightness, self.brightness)
+        return np.clip(x, 0, 255).astype(np.uint8)
+
+
+class PipelineImageTransform(ImageTransform):
+    """Chain of (transform, probability) applied in order — ref
+    ``PipelineImageTransform`` (shuffle=False semantics)."""
+
+    def __init__(self, steps: Sequence, probabilities: Optional[Sequence[float]] = None):
+        self.steps = list(steps)
+        self.probs = list(probabilities) if probabilities else [1.0] * len(self.steps)
+
+    def transform(self, img, rng):
+        for t, p in zip(self.steps, self.probs):
+            if p >= 1.0 or rng.rand() < p:
+                img = t.transform(img, rng)
+        return img
+
+
+# ------------------------------------------------------------------ reader
+
+
+class ImageRecordReader(RecordReader):
+    """org.datavec.image.recordreader.ImageRecordReader: decode → (optional
+    transform chain) → resize to (height, width) → CHW float32 + label index.
+
+    ``next()`` returns ``[chw_array, label_idx]`` (the NDArrayWritable +
+    label Writable pair of the reference); use ``ImageRecordReaderDataSetIterator``
+    to batch into DataSets.
+    """
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 label_generator: Optional[PathLabelGenerator] = None,
+                 transform: Optional[ImageTransform] = None, seed: int = 123):
+        self.height, self.width, self.channels = height, width, channels
+        self.label_gen = label_generator
+        self.transform = transform
+        self.seed = seed
+        self._files: List[str] = []
+        self._labels: List[str] = []
+        self._label_idx: dict = {}
+        self._i = 0
+
+    def initialize(self, split: InputSplit) -> "ImageRecordReader":
+        self._files = [f for f in split.locations()
+                       if f.lower().endswith(_IMG_EXTS)]
+        if self.label_gen is not None:
+            self._labels = sorted({self.label_gen.label_for_path(f) for f in self._files})
+            self._label_idx = {l: i for i, l in enumerate(self._labels)}
+        self._i = 0
+        return self
+
+    def labels(self) -> List[str]:
+        return list(self._labels)
+
+    def num_labels(self) -> int:
+        return len(self._labels)
+
+    def has_next(self) -> bool:
+        return self._i < len(self._files)
+
+    def reset(self):
+        self._i = 0
+
+    def next(self) -> List:
+        idx = self._i
+        self._i += 1
+        return self.read_index(idx)
+
+    def read_index(self, idx: int) -> List:
+        """Decode + augment file #idx. Augmentation rng is seeded per image
+        index, so results are deterministic under ANY execution order —
+        including the thread-pool batching below."""
+        path = self._files[idx]
+        img = self._decode(path)
+        if self.transform is not None:
+            rng = np.random.RandomState((self.seed * 1_000_003 + idx) % (1 << 31))
+            img = self.transform.transform(img, rng)
+        img = self._to_chw(img)
+        if self.label_gen is None:
+            return [img]
+        return [img, self._label_idx[self.label_gen.label_for_path(path)]]
+
+    def take_indices(self, n: int) -> List[int]:
+        """Claim the next n file indices (for batched parallel decode)."""
+        start = self._i
+        end = min(start + n, len(self._files))
+        self._i = end
+        return list(range(start, end))
+
+    # -- decode helpers (NativeImageLoader.asMatrix equivalents) ------------
+
+    def _decode(self, path: str) -> np.ndarray:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert("RGB" if self.channels == 3 else "L")
+            return np.asarray(im)
+
+    def _to_chw(self, img: np.ndarray) -> np.ndarray:
+        from PIL import Image
+
+        if img.shape[0] != self.height or img.shape[1] != self.width:
+            img = np.asarray(Image.fromarray(img).resize(
+                (self.width, self.height), Image.BILINEAR))
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img.astype(np.float32).transpose(2, 0, 1)  # HWC → CHW
+
+
+class ImageRecordReaderDataSetIterator(DataSetIterator):
+    """Batches ImageRecordReader rows into NCHW DataSets (the image-typed
+    RecordReaderDataSetIterator constructor of the reference).
+
+    ``num_workers`` decodes a batch's images on a thread pool — PIL's decode
+    and numpy transforms release the GIL, so this parallelizes like the
+    reference's multi-threaded OpenCV ETL; per-image seeded augmentation rng
+    keeps results order-independent. Wrap in ``AsyncDataSetIterator`` to
+    additionally overlap whole batches with device steps.
+    """
+
+    def __init__(self, reader: ImageRecordReader, batch_size: int,
+                 num_classes: Optional[int] = None, preprocessor=None,
+                 num_workers: int = 0):
+        self.reader = reader
+        self.batch_size = batch_size
+        self._num_classes = num_classes
+        self.preprocessor = preprocessor
+        self.num_workers = num_workers
+        self._pool = None
+
+    @property
+    def num_classes(self):
+        # lazy: the reader may be initialize()d after this iterator is built
+        return self._num_classes or self.reader.num_labels() or None
+
+    def reset(self):
+        self.reader.reset()
+
+    def has_next(self) -> bool:
+        return self.reader.has_next()
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def _rows(self):
+        idxs = self.reader.take_indices(self.batch_size)
+        if self.num_workers and len(idxs) > 1:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(self.num_workers)
+            return list(self._pool.map(self.reader.read_index, idxs))
+        return [self.reader.read_index(i) for i in idxs]
+
+    def next(self) -> DataSet:
+        rows = self._rows()
+        xs = [r[0] for r in rows]
+        ys = [r[1] for r in rows if len(r) > 1]
+        x = np.stack(xs)
+        y = (np.eye(self.num_classes, dtype=np.float32)[np.asarray(ys)]
+             if ys else None)
+        ds = DataSet(x, y)
+        if self.preprocessor is not None:
+            self.preprocessor.transform(ds)
+        return ds

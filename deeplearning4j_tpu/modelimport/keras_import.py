@@ -45,7 +45,7 @@ from ..nn.conf import (
     OutputLayer,
     SubsamplingLayer,
 )
-from ..nn.graph_conf import ElementWiseVertex, MergeVertex
+from ..nn.graph_conf import ElementWiseVertex, FlattenVertex, MergeVertex
 
 _ACT = {"linear": "identity", None: "identity"}
 
@@ -183,6 +183,13 @@ def _map_layer(cls: str, cfg: dict, w: Optional[dict], ctx: _Ctx, it: InputType,
         else:
             layer = DenseLayer(**common)
         return [layer], [_dense_params(w, perm)], None
+    if cls in ("Conv2D", "MaxPooling2D", "AveragePooling2D",
+               "GlobalMaxPooling2D", "GlobalAveragePooling2D"):
+        if cfg.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError(
+                f"{cls} data_format={cfg['data_format']!r} unsupported: the "
+                "importer assumes Keras channels_last (re-save the model "
+                "with the default data_format)")
     if cls == "Conv2D":
         layer = ConvolutionLayer(
             n_out=cfg["filters"],
@@ -302,11 +309,14 @@ class KerasModelImport:
         if d >= 0 and all(l["class_name"] in ("Activation", "Dropout")
                           for l in body[d + 1:]):
             last_param_pos = d
-            for j in range(d + 1, len(body)):
-                if body[j]["class_name"] == "Activation":
-                    body[d]["config"]["activation"] = body[j]["config"]["activation"]
-                    del body[j]
+            for l in body[d + 1:]:
+                if l["class_name"] == "Activation":
+                    body[d]["config"]["activation"] = l["config"]["activation"]
                     break
+            # trailing Activation folded in; trailing Dropout is an inference
+            # no-op — both are STRIPPED so the OutputLayer stays terminal
+            # (MultiLayerNetwork's loss head is layers[-1])
+            del body[d + 1:]
         for i, kl in enumerate(body):
             lname = kl["config"].get("name", kl["class_name"])
             w = weights.get(lname)
@@ -379,7 +389,7 @@ class KerasModelImport:
                 is_output=(name in outputs and cls == "Dense"))
             if not layers:  # Flatten
                 # pass-through node so downstream wiring stays by name
-                gb.add_vertex(name, _FlattenVertex(), *srcs)
+                gb.add_vertex(name, FlattenVertex(), *srcs)
                 it = types[src]
                 types[name] = InputType.feed_forward(it.flat_size())
                 flat_from[name] = ((it.height, it.width, it.channels)
@@ -416,17 +426,6 @@ class KerasModelImport:
         _transplant(net.params_, params_by_name)
         _transplant(net.bn_state, bn_by_name)
         return net
-
-
-class _FlattenVertex(ElementWiseVertex):
-    """[B,C,H,W] → [B, C*H*W] pass-through for functional Flatten nodes."""
-
-    def apply(self, inputs):
-        x = inputs[0]
-        return x.reshape(x.shape[0], -1)
-
-    def output_type(self, its):
-        return InputType.feed_forward(its[0].flat_size())
 
 
 def _inbound_names(kl: dict) -> List[str]:

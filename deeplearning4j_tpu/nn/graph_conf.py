@@ -158,6 +158,19 @@ class PreprocessorVertex(GraphVertex):
         return self.pre.output_type(its[0])
 
 
+@dataclass
+class FlattenVertex(GraphVertex):
+    """[B, ...] → [B, prod(...)] (used by Keras-import Flatten nodes; the
+    framework's own stacks flatten via CnnToFeedForward preprocessors)."""
+
+    def apply(self, inputs):
+        x = inputs[0]
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, its):
+        return InputType.feed_forward(its[0].flat_size())
+
+
 VERTEX_REGISTRY = {
     c.__name__: c
     for c in (
@@ -170,6 +183,7 @@ VERTEX_REGISTRY = {
         ScaleVertex,
         ShiftVertex,
         ReshapeVertex,
+        FlattenVertex,
     )
 }
 
