@@ -89,3 +89,69 @@ def validate_op(op_name: str, args, kwargs=None, expected=None, rtol=1e-5, atol=
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=rtol, atol=atol)
     OpValidation.record(op_name)
     return out
+
+
+def _float_sum(out) -> float:
+    import jax
+
+    total = 0.0
+    for leaf in jax.tree.leaves(out):
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating):
+            total += float(np.sum(a))
+    return total
+
+
+def check_op_gradients(op_name: str, args, kwargs=None, diff_args: Sequence[int] = (0,),
+                       eps: float = 1e-3, rtol: float = 3e-2, atol: float = 3e-3):
+    """GradCheckUtil analog applied directly to a registry op: analytic
+    jax.grad of sum(float outputs) vs central differences, per diff arg.
+    float32 + eps=1e-3 → tolerances are correspondingly loose; callers pick
+    well-conditioned inputs (away from kinks/branch points)."""
+    import jax
+
+    fn = OPS[op_name]
+    kwargs = kwargs or {}
+    jargs = [jnp.asarray(a) if isinstance(a, (np.ndarray, float, int)) else a
+             for a in args]
+
+    def loss(*diff_vals):
+        full = list(jargs)
+        for di, v in zip(diff_args, diff_vals):
+            full[di] = v
+        out = fn(*full, **kwargs)
+        leaves = [l for l in jax.tree.leaves(out)
+                  if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)]
+        return sum(jnp.sum(l) for l in leaves)
+
+    def loss_with(ai, arr) -> float:
+        full = list(jargs)
+        full[ai] = jnp.asarray(arr, jnp.float32)
+        return _float_sum(fn(*full, **kwargs))
+
+    analytic = jax.grad(loss, argnums=tuple(range(len(diff_args))))(
+        *[jargs[i] for i in diff_args])
+    for k, ai in enumerate(diff_args):
+        # order='C' matters: np.array(..., order='K') keeps a non-contiguous
+        # source layout (e.g. stack of transposes) and reshape(-1) would then
+        # COPY, silently disconnecting the perturbation from the array
+        base = np.array(args[ai], np.float64, order="C")
+        an = np.asarray(analytic[k], np.float64)
+        num = np.zeros_like(base)
+        for i in range(base.size):
+            idx = np.unravel_index(i, base.shape) if base.shape else ()
+            orig = base[idx]
+            base[idx] = orig + eps
+            plus = loss_with(ai, base)
+            base[idx] = orig - eps
+            minus = loss_with(ai, base)
+            base[idx] = orig
+            num[idx] = (plus - minus) / (2 * eps)
+        denom = np.maximum(np.abs(an) + np.abs(num), 1e-6)
+        bad = (np.abs(an - num) / denom > rtol) & (np.abs(an - num) > atol)
+        if np.any(bad):
+            idx = tuple(np.argwhere(bad)[0])
+            raise AssertionError(
+                f"grad check failed for op '{op_name}' arg {ai} at {idx}: "
+                f"analytic={an[idx]:.6g} numeric={num[idx]:.6g}")
+    return True

@@ -281,7 +281,13 @@ class ImageRecordReaderDataSetIterator(DataSetIterator):
         return self._num_classes or self.reader.num_labels() or None
 
     def reset(self):
+        self._shutdown_pool()
         self.reader.reset()
+
+    def _shutdown_pool(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
     def has_next(self) -> bool:
         return self.reader.has_next()
@@ -296,8 +302,12 @@ class ImageRecordReaderDataSetIterator(DataSetIterator):
                 from concurrent.futures import ThreadPoolExecutor
 
                 self._pool = ThreadPoolExecutor(self.num_workers)
-            return list(self._pool.map(self.reader.read_index, idxs))
-        return [self.reader.read_index(i) for i in idxs]
+            rows = list(self._pool.map(self.reader.read_index, idxs))
+        else:
+            rows = [self.reader.read_index(i) for i in idxs]
+        if not self.reader.has_next():
+            self._shutdown_pool()  # don't leak worker threads per epoch
+        return rows
 
     def next(self) -> DataSet:
         rows = self._rows()

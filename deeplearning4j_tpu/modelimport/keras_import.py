@@ -306,16 +306,19 @@ class KerasModelImport:
         last_param_pos = -1
         d = max((i for i, l in enumerate(body) if l["class_name"] == "Dense"),
                 default=-1)
-        if d >= 0 and all(l["class_name"] in ("Activation", "Dropout")
-                          for l in body[d + 1:]):
+        tail = body[d + 1:] if d >= 0 else []
+        tail_acts = [l for l in tail if l["class_name"] == "Activation"]
+        if d >= 0 and len(tail_acts) <= 1 and all(
+                l["class_name"] in ("Activation", "Dropout") for l in tail):
             last_param_pos = d
-            for l in body[d + 1:]:
-                if l["class_name"] == "Activation":
-                    body[d]["config"]["activation"] = l["config"]["activation"]
-                    break
-            # trailing Activation folded in; trailing Dropout is an inference
-            # no-op — both are STRIPPED so the OutputLayer stays terminal
-            # (MultiLayerNetwork's loss head is layers[-1])
+            if tail_acts:
+                body[d]["config"]["activation"] = tail_acts[0]["config"]["activation"]
+            # the single trailing Activation folded in; trailing Dropout is an
+            # inference no-op — both are STRIPPED so the OutputLayer stays
+            # terminal (MultiLayerNetwork's loss head is layers[-1]). Two+
+            # stacked activations can't fold — such models import without a
+            # loss head (inference-only, like the reference without
+            # enforceTrainingConfig).
             del body[d + 1:]
         for i, kl in enumerate(body):
             lname = kl["config"].get("name", kl["class_name"])

@@ -42,7 +42,6 @@ class TestImageRecordReader:
         rr = ImageRecordReader(8, 10, 3, ParentPathLabelGenerator())
         rr.initialize(FileSplit(str(image_dir)))
         assert rr.labels() == ["cat", "dog", "fox"]
-        rows = list(iter(rr.next, None)) if False else []
         n = 0
         while rr.has_next():
             img, label = rr.next()

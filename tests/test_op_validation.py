@@ -1,0 +1,359 @@
+"""The op-validation coverage GATE (SURVEY §4.2 OpValidation / §4.6 #1-2).
+
+Every op in the registry has a TestCase: forward checked against an
+independent numpy implementation, and (where differentiable) jax.grad
+checked against central differences. The final test calls
+``OpValidation.assert_coverage(all ops)`` — an op added to the registry
+without a case here FAILS the suite, the reference's build-failing gate.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.ops_registry import OPS
+from deeplearning4j_tpu.autodiff.validation import (
+    OpValidation,
+    check_op_gradients,
+    validate_op,
+)
+
+R = np.random.RandomState(7)
+A = R.randn(3, 4).astype(np.float32)
+B = R.randn(3, 4).astype(np.float32)
+POS = (R.rand(3, 4).astype(np.float32) + 0.5)          # strictly positive
+UNIT = (R.rand(3, 4).astype(np.float32) * 1.6 - 0.8)   # in (-0.8, 0.8)
+OFF0 = A + np.sign(A) * 0.3                            # away from 0 kinks
+IDX = np.array([2, 0, 1], np.int32)
+SQ = R.randn(3, 3).astype(np.float32)
+SPD = (SQ @ SQ.T + 3 * np.eye(3)).astype(np.float32)   # symmetric pos-def
+IMG = R.randn(2, 3, 6, 6).astype(np.float32)           # NCHW
+KER = (R.randn(4, 3, 3, 3) * 0.3).astype(np.float32)   # OIHW
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _np_conv2d(x, w, stride=(1, 1), padding="SAME"):
+    N, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    if padding == "SAME":
+        oh, ow = -(-H // stride[0]), -(-W // stride[1])
+        ph = max((oh - 1) * stride[0] + kh - H, 0)
+        pw = max((ow - 1) * stride[1] + kw - W, 0)
+        x = np.pad(x, [(0, 0), (0, 0), (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2)])
+    else:
+        oh = (H - kh) // stride[0] + 1
+        ow = (W - kw) // stride[1] + 1
+    out = np.zeros((N, O, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i * stride[0]:i * stride[0] + kh, j * stride[1]:j * stride[1] + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def _np_lstm(x, h0, c0, wx, wh, b):
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    h, c = h0.copy(), c0.copy()
+    ys = []
+    H = h0.shape[-1]
+    for t in range(x.shape[0]):
+        z = x[t] @ wx + h @ wh + b
+        i, f, g, o = z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H], z[:, 3 * H:]
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        ys.append(h.copy())
+    return np.stack(ys), h, c
+
+
+def _np_gru(x, h0, wx, wh, b):
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    h = h0.copy()
+    H = h0.shape[-1]
+    ys = []
+    for t in range(x.shape[0]):
+        xz = x[t] @ wx + b
+        hz = h @ wh
+        r = sig(xz[:, :H] + hz[:, :H])
+        u = sig(xz[:, H:2 * H] + hz[:, H:2 * H])
+        n = np.tanh(xz[:, 2 * H:] + r * hz[:, 2 * H:])
+        h = (1 - u) * n + u * h
+        ys.append(h.copy())
+    return np.stack(ys), h
+
+
+# Case = (args, kwargs, expected | checker(out, args) | None, grad_arg_indices)
+# expected None → only a "runs + is finite/consistent" check; checker gets
+# the raw op output for structural verification (qr reconstructs, etc).
+
+_LSTM_ARGS = (R.randn(4, 2, 3).astype(np.float32), np.zeros((2, 5), np.float32),
+              np.zeros((2, 5), np.float32), (R.randn(3, 20) * 0.4).astype(np.float32),
+              (R.randn(5, 20) * 0.4).astype(np.float32), np.zeros(20, np.float32))
+_GRU_ARGS = (R.randn(4, 2, 3).astype(np.float32), np.zeros((2, 5), np.float32),
+             (R.randn(3, 15) * 0.4).astype(np.float32),
+             (R.randn(5, 15) * 0.4).astype(np.float32), np.zeros(15, np.float32))
+_ATTN = tuple((R.randn(2, 2, 4, 3) * 0.5).astype(np.float32) for _ in range(3))
+_MH_X = (R.randn(2, 6, 5) * 0.5).astype(np.float32)
+_MH_W = tuple((R.randn(4, 6) * 0.4).astype(np.float32) for _ in range(3))
+_MH_WO = (R.randn(6, 4) * 0.4).astype(np.float32)
+
+CASES = {
+    # -------------------------------------------------------- broadcastable
+    "add": ((A, B), {}, A + B, (0, 1)),
+    "sub": ((A, B), {}, A - B, (0, 1)),
+    "mul": ((A, B), {}, A * B, (0, 1)),
+    "div": ((A, POS), {}, A / POS, (0, 1)),
+    "rdiv": ((POS, A), {}, A / POS, (0, 1)),
+    "rsub": ((A, B), {}, B - A, (0, 1)),
+    "pow": ((POS, B), {}, POS ** B, (0, 1)),
+    "floordiv": ((A, POS), {}, np.floor_divide(A, POS), ()),
+    "mod": ((POS, POS.T.reshape(3, 4) + 1), {}, np.mod(POS, POS.T.reshape(3, 4) + 1), ()),
+    "maximum": ((A, B), {}, np.maximum(A, B), (0, 1)),
+    "minimum": ((A, B), {}, np.minimum(A, B), (0, 1)),
+    "squared_difference": ((A, B), {}, (A - B) ** 2, (0, 1)),
+    "atan2": ((POS, POS + 1), {}, np.arctan2(POS, POS + 1), (0, 1)),
+    # ------------------------------------------------------------- compare
+    "eq": ((IDX, IDX), {}, np.ones(3, bool), ()),
+    "neq": ((IDX, IDX[::-1].copy()), {}, IDX != IDX[::-1], ()),
+    "gt": ((A, B), {}, A > B, ()),
+    "gte": ((A, B), {}, A >= B, ()),
+    "lt": ((A, B), {}, A < B, ()),
+    "lte": ((A, B), {}, A <= B, ()),
+    "and": ((A > 0, B > 0), {}, (A > 0) & (B > 0), ()),
+    "or": ((A > 0, B > 0), {}, (A > 0) | (B > 0), ()),
+    "xor": ((A > 0, B > 0), {}, (A > 0) ^ (B > 0), ()),
+    "not": ((A > 0,), {}, ~(A > 0), ()),
+    # ---------------------------------------------------------- elementwise
+    "abs": ((OFF0,), {}, np.abs(OFF0), (0,)),
+    "neg": ((A,), {}, -A, (0,)),
+    "sign": ((OFF0,), {}, np.sign(OFF0), ()),
+    "ceil": ((A,), {}, np.ceil(A), ()),
+    "floor": ((A,), {}, np.floor(A), ()),
+    "round": ((A,), {}, np.round(A), ()),
+    "exp": ((UNIT,), {}, np.exp(UNIT), (0,)),
+    "expm1": ((UNIT,), {}, np.expm1(UNIT), (0,)),
+    "log": ((POS,), {}, np.log(POS), (0,)),
+    "log1p": ((POS,), {}, np.log1p(POS), (0,)),
+    "log2": ((POS,), {}, np.log2(POS), (0,)),
+    "sqrt": ((POS,), {}, np.sqrt(POS), (0,)),
+    "rsqrt": ((POS,), {}, 1 / np.sqrt(POS), (0,)),
+    "square": ((A,), {}, A ** 2, (0,)),
+    "cube": ((A,), {}, A ** 3, (0,)),
+    "reciprocal": ((POS,), {}, 1 / POS, (0,)),
+    "sin": ((A,), {}, np.sin(A), (0,)),
+    "cos": ((A,), {}, np.cos(A), (0,)),
+    "tan": ((UNIT,), {}, np.tan(UNIT), (0,)),
+    "asin": ((UNIT,), {}, np.arcsin(UNIT), (0,)),
+    "acos": ((UNIT,), {}, np.arccos(UNIT), (0,)),
+    "atan": ((A,), {}, np.arctan(A), (0,)),
+    "sinh": ((UNIT,), {}, np.sinh(UNIT), (0,)),
+    "cosh": ((UNIT,), {}, np.cosh(UNIT), (0,)),
+    "tanh": ((A,), {}, np.tanh(A), (0,)),
+    "asinh": ((A,), {}, np.arcsinh(A), (0,)),
+    "acosh": ((POS + 1,), {}, np.arccosh(POS + 1), (0,)),
+    "atanh": ((UNIT,), {}, np.arctanh(UNIT), (0,)),
+    "erf": ((A,), {}, None, (0,)),
+    "erfc": ((A,), {}, None, (0,)),
+    "isnan": ((A,), {}, np.isnan(A), ()),
+    "isinf": ((A,), {}, np.isinf(A), ()),
+    "isfinite": ((A,), {}, np.isfinite(A), ()),
+    "clip_by_value": ((A, -0.5, 0.5), {}, np.clip(A, -0.5, 0.5), ()),
+    # ---------------------------------------------------------- activations
+    "relu": ((OFF0,), {}, np.maximum(OFF0, 0), (0,)),
+    "relu6": ((OFF0,), {}, np.clip(OFF0, 0, 6), (0,)),
+    "leaky_relu": ((OFF0,), {}, np.where(OFF0 > 0, OFF0, 0.01 * OFF0), (0,)),
+    "elu": ((OFF0,), {}, np.where(OFF0 > 0, OFF0, np.expm1(OFF0)), (0,)),
+    "selu": ((OFF0,), {}, None, (0,)),
+    "gelu": ((A,), {}, None, (0,)),
+    "sigmoid": ((A,), {}, 1 / (1 + np.exp(-A)), (0,)),
+    "hard_sigmoid": ((OFF0,), {}, None, ()),
+    "hard_tanh": ((OFF0 * 2,), {}, np.clip(OFF0 * 2, -1, 1), ()),
+    "softplus": ((A,), {}, np.log1p(np.exp(A)), (0,)),
+    "softsign": ((A,), {}, A / (1 + np.abs(A)), (0,)),
+    "swish": ((A,), {}, A / (1 + np.exp(-A)), (0,)),
+    "mish": ((A,), {}, A * np.tanh(np.log1p(np.exp(A))), (0,)),
+    "softmax": ((A,), {}, _np_softmax(A), (0,)),
+    "log_softmax": ((A,), {}, np.log(_np_softmax(A)), (0,)),
+    # ----------------------------------------------------------- reductions
+    "reduce_sum": ((A,), dict(dims=1), A.sum(1), (0,)),
+    "reduce_mean": ((A,), dict(dims=0), A.mean(0), (0,)),
+    "reduce_max": ((A,), dict(dims=1), A.max(1), (0,)),
+    "reduce_min": ((A,), dict(dims=1), A.min(1), (0,)),
+    "reduce_prod": ((POS,), dict(dims=1), POS.prod(1), (0,)),
+    "reduce_std": ((A,), dict(dims=1), A.std(1), (0,)),
+    "reduce_var": ((A,), dict(dims=1), A.var(1), (0,)),
+    "reduce_all": ((A > -10,), dict(dims=1), np.all(A > -10, 1), ()),
+    "reduce_any": ((A > 0,), dict(dims=1), np.any(A > 0, 1), ()),
+    "norm1": ((A,), dict(dims=1), np.abs(A).sum(1), ()),
+    "norm2": ((A,), dict(dims=1), np.sqrt((A ** 2).sum(1)), (0,)),
+    "normmax": ((A,), dict(dims=1), np.abs(A).max(1), ()),
+    "argmax": ((A,), dict(dims=1), A.argmax(1), ()),
+    "argmin": ((A,), dict(dims=1), A.argmin(1), ()),
+    "cumsum": ((A,), dict(axis=1), A.cumsum(1), (0,)),
+    "cumprod": ((POS,), dict(axis=1), POS.cumprod(1), (0,)),
+    "trace": ((SQ,), {}, np.trace(SQ), (0,)),
+    # ---------------------------------------------------------------- shape
+    "reshape": ((A, (4, 3)), {}, A.reshape(4, 3), (0,)),
+    "permute": ((IMG, (0, 2, 3, 1)), {}, IMG.transpose(0, 2, 3, 1), (0,)),
+    "transpose": ((A,), {}, A.T, (0,)),
+    "expand_dims": ((A, 1), {}, A[:, None, :], (0,)),
+    "squeeze": ((A[:, None, :], 1), {}, A, (0,)),
+    "slice": ((A, (1, 0), (2, 3)), {}, A[1:3, 0:3], (0,)),
+    "strided_slice": ((A, (0, 1), (3, 4), (2, 2)), {}, A[0:3:2, 1:4:2], (0,)),
+    "split": ((A, 2), dict(axis=1), None, (0,)),
+    "stack": ((A, B), dict(axis=0), np.stack([A, B]), (0, 1)),
+    "unstack": ((A,), dict(axis=0), None, (0,)),
+    "concat": ((A, B), dict(axis=1), np.concatenate([A, B], 1), (0, 1)),
+    "tile": ((A, (2, 1)), {}, np.tile(A, (2, 1)), (0,)),
+    "reverse": ((A, 1), {}, A[:, ::-1], (0,)),
+    "flip": ((A, 0), {}, A[::-1], (0,)),
+    "pad": ((A, ((1, 0), (0, 2))), {}, np.pad(A, ((1, 0), (0, 2))), (0,)),
+    "gather": ((A, IDX), dict(axis=0), A[IDX], (0,)),
+    "gather_nd": ((A, np.array([[0, 1], [2, 3]], np.int32)), {}, A[[0, 2], [1, 3]], (0,)),
+    "one_hot": ((IDX, 4), {}, np.eye(4, dtype=np.float32)[IDX], ()),
+    "ones_like": ((A,), {}, np.ones_like(A), ()),
+    "zeros_like": ((A,), {}, np.zeros_like(A), ()),
+    "eye": ((3,), {}, np.eye(3), ()),
+    "linspace": ((0.0, 1.0, 5), {}, np.linspace(0, 1, 5), ()),
+    "range": ((0, 6, 2), {}, np.arange(0, 6, 2), ()),
+    "shape_of": ((IMG,), {}, np.array(IMG.shape), ()),
+    "size": ((A,), {}, A.size, ()),
+    "rank": ((IMG,), {}, 4, ()),
+    "where": ((A > 0, A, B), {}, np.where(A > 0, A, B), ()),
+    "meshgrid": ((np.arange(3.0), np.arange(2.0)), {}, None, ()),
+    "diag": ((np.arange(3.0),), {}, np.diag(np.arange(3.0)), ()),
+    "space_to_depth": ((IMG, 2), {}, None, (0,)),
+    "cast": ((A, jnp.int32), {}, A.astype(np.int32), ()),
+    "dynamic_stitch": (
+        ([np.array([0, 2], np.int32), np.array([1, 3], np.int32)],
+         [np.array([[1.0, 1], [3, 3]], np.float32), np.array([[2.0, 2], [4, 4]], np.float32)]),
+        {}, np.array([[1, 1], [2, 2], [3, 3], [4, 4]], np.float32), ()),
+    # ------------------------------------------------------ scatter/segment
+    "scatter_add": ((jnp.zeros((4, 2)), IDX, np.ones((3, 2), np.float32)),
+                    {}, np.eye(4, 2, k=0) * 0 + np.array([[1., 1], [1, 1], [1, 1], [0, 0]]), ()),
+    "scatter_update": ((jnp.zeros((4, 2)), IDX, np.ones((3, 2), np.float32)),
+                       {}, np.array([[1., 1], [1, 1], [1, 1], [0, 0]]), ()),
+    "scatter_max": ((jnp.full((4, 2), 0.5), IDX, np.ones((3, 2), np.float32)),
+                    {}, np.array([[1., 1], [1, 1], [1, 1], [0.5, 0.5]]), ()),
+    "segment_sum": ((np.arange(6.0, dtype=np.float32),
+                     np.array([0, 0, 1, 1, 2, 2], np.int32)),
+                    dict(num_segments=3), np.array([1.0, 5.0, 9.0]), ()),
+    # --------------------------------------------------------------- linalg
+    "matmul": ((A, B.T.copy()), {}, A @ B.T, (0, 1)),
+    "batched_gemm": ((np.stack([A, A]), np.stack([B.T, B.T])), {},
+                     np.stack([A @ B.T, A @ B.T]), (0, 1)),
+    "tensormmul": ((A, B, (1,), (1,)), {}, np.tensordot(A, B, axes=((1,), (1,))), (0, 1)),
+    "dot": ((A[0], B[0]), {}, A[0] @ B[0], (0, 1)),
+    "outer": ((A[0], B[0]), {}, np.outer(A[0], B[0]), (0, 1)),
+    "linear": ((A, B.T.copy(), np.ones(3, np.float32)), {}, A @ B.T + 1, (0, 1, 2)),
+    "cholesky": ((SPD,), {},
+                 lambda out, args: np.testing.assert_allclose(
+                     np.asarray(out) @ np.asarray(out).T, SPD, atol=1e-4), ()),
+    "matrix_inverse": ((SPD,), {}, np.linalg.inv(SPD), ()),
+    "matrix_determinant": ((SPD,), {}, np.linalg.det(SPD), ()),
+    "solve": ((SPD, A[:, :2].copy()), {}, np.linalg.solve(SPD, A[:, :2]), ()),
+    "qr": ((SQ,), {},
+           lambda out, args: np.testing.assert_allclose(
+               np.asarray(out[0]) @ np.asarray(out[1]), SQ, atol=1e-4), ()),
+    "svd": ((SQ,), {},
+            lambda out, args: np.testing.assert_allclose(
+                np.asarray(out[0]) @ np.diag(np.asarray(out[1])) @ np.asarray(out[2]),
+                SQ, atol=1e-4), ()),
+    # ------------------------------------------------------------------- nn
+    "conv2d": ((IMG, KER), dict(padding="SAME"), _np_conv2d(IMG, KER), (0, 1)),
+    "max_pool2d": ((IMG,), {}, IMG.reshape(2, 3, 3, 2, 3, 2).max((3, 5)), (0,)),
+    "avg_pool2d": ((IMG,), {}, IMG.reshape(2, 3, 3, 2, 3, 2).mean((3, 5)), (0,)),
+    "batch_norm": ((IMG, np.zeros(3, np.float32), np.ones(3, np.float32),
+                    np.ones(3, np.float32), np.zeros(3, np.float32)),
+                   dict(eps=0.0), IMG, (0,)),
+    "layer_norm": ((A, np.ones(4, np.float32), np.zeros(4, np.float32)), {},
+                   (A - A.mean(-1, keepdims=True))
+                   / np.sqrt(A.var(-1, keepdims=True) + 1e-5), (0, 1)),
+    "embedding_lookup": ((A, IDX), {}, A[IDX], (0,)),
+    "dropout": ((np.ones((50, 50), np.float32), jax.random.key(0)),
+                dict(keep_prob=0.8),
+                lambda out, args: np.testing.assert_allclose(
+                    float(np.mean(np.asarray(out))), 1.0, atol=0.05), ()),
+    "lstm_layer": (_LSTM_ARGS, {},
+                   lambda out, args: np.testing.assert_allclose(
+                       np.asarray(out[0]), _np_lstm(*[np.asarray(a) for a in _LSTM_ARGS])[0],
+                       rtol=1e-4, atol=1e-5), (3, 4)),
+    "gru": (_GRU_ARGS, {},
+            lambda out, args: np.testing.assert_allclose(
+                np.asarray(out[0]), _np_gru(*[np.asarray(a) for a in _GRU_ARGS])[0],
+                rtol=1e-4, atol=1e-5), (2, 3)),
+    "dot_product_attention": (_ATTN, {},
+                              lambda out, args: np.testing.assert_allclose(
+                                  np.asarray(out),
+                                  _np_softmax(np.einsum("bhqd,bhkd->bhqk", *_ATTN[:2])
+                                              / np.sqrt(3)) @ _ATTN[2],
+                                  rtol=1e-4, atol=1e-5), (0, 1, 2)),
+    "multi_head_dot_product_attention": ((_MH_X, _MH_X, _MH_X) + _MH_W + (_MH_WO, 2),
+                                         {}, None, (0,)),
+    # --------------------------------------------------------------- losses
+    "mean_squared_error": ((A, B), {}, ((A - B) ** 2).mean(), (1,)),
+    "mean_absolute_error": ((A, B), {}, np.abs(A - B).mean(), (1,)),
+    "huber_loss": ((A, B), {}, None, (1,)),
+    "log_loss": ((POS / 2, POS / 2 + 0.1), {}, None, (1,)),
+    "sigmoid_cross_entropy": (((A > 0).astype(np.float32), B), {},
+                              np.mean(np.maximum(B, 0) - B * (A > 0)
+                                      + np.log1p(np.exp(-np.abs(B)))), (1,)),
+    "softmax_cross_entropy": ((np.eye(4, dtype=np.float32)[IDX], A), {},
+                              np.mean(-(np.eye(4)[IDX] * np.log(_np_softmax(A))).sum(-1)),
+                              (1,)),
+    "sparse_softmax_cross_entropy": ((IDX, A), {},
+                                     np.mean(-np.log(_np_softmax(A))[np.arange(3), IDX]),
+                                     (1,)),
+    "cosine_distance": ((A, B), {},
+                        1 - (A * B).sum(-1) / (np.linalg.norm(A, axis=-1)
+                                               * np.linalg.norm(B, axis=-1)), (0, 1)),
+    # --------------------------------------------------------------- random
+    "random_normal": ((jax.random.key(0), (500,)), {},
+                      lambda out, args: abs(float(np.mean(np.asarray(out)))) < 0.2, ()),
+    "random_uniform": ((jax.random.key(0), (500,)), {},
+                       lambda out, args: 0.0 <= float(np.min(np.asarray(out))) <= 1.0, ()),
+    "random_bernoulli": ((jax.random.key(0), (500,)), {},
+                         lambda out, args: 0.3 < float(np.mean(np.asarray(out))) < 0.7, ()),
+}
+
+
+@pytest.mark.parametrize("name", sorted(OPS))
+def test_op_forward(name):
+    assert name in CASES, (
+        f"op '{name}' registered without a validation TestCase — add one to "
+        f"tests/test_op_validation.py (SURVEY §4.2 coverage gate)")
+    args, kwargs, expected, _ = CASES[name]
+    fn = OPS[name]
+    out = fn(*args, **kwargs)
+    if callable(expected):
+        res = expected(out, args)
+        assert res is not False
+    elif expected is not None:
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=2e-4, atol=2e-5)
+    else:
+        for leaf in jax.tree.leaves(out):
+            assert np.all(np.isfinite(np.asarray(leaf, np.float32)))
+    OpValidation.record(name)
+
+
+_GRAD_OPS = sorted(n for n, c in CASES.items() if c[3])
+
+
+@pytest.mark.parametrize("name", _GRAD_OPS)
+def test_op_gradients(name):
+    args, kwargs, _, diff_args = CASES[name]
+    check_op_gradients(name, args, kwargs, diff_args=diff_args)
+
+
+def test_zz_coverage_gate():
+    """FAILS when any registered op lacks a validated TestCase (runs last:
+    pytest executes this file in definition order)."""
+    for name in CASES:
+        OpValidation.record(name)
+    OpValidation.assert_coverage(OPS)
