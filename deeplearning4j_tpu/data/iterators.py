@@ -72,6 +72,13 @@ class ListDataSetIterator(DataSetIterator):
     def batch(self) -> int:
         return self._batch
 
+    # checkpointable position (SURVEY §5.4 iterator-state gap)
+    def state(self) -> dict:
+        return {"pos": self._pos}
+
+    def set_state(self, s: dict) -> None:
+        self._pos = int(s["pos"])
+
 
 class ArrayDataSetIterator(DataSetIterator):
     """Batches over in-memory (features, labels) arrays, optional shuffle per
@@ -106,6 +113,22 @@ class ArrayDataSetIterator(DataSetIterator):
 
     def batch(self) -> int:
         return self.batch_size
+
+    # checkpointable position (SURVEY §5.4 iterator-state gap): (pos, epoch)
+    # only — the shuffle order is reconstructed by replaying the seeded
+    # per-epoch shuffles, so state stays O(1) bytes regardless of dataset
+    # size (it is written on the synchronous preemption path)
+    def state(self) -> dict:
+        return {"pos": int(self._pos), "epoch": int(self._epoch)}
+
+    def set_state(self, s: dict) -> None:
+        self._pos = int(s["pos"])
+        self._epoch = int(s["epoch"])
+        self._order = np.arange(self.features.shape[0])
+        if self.shuffle:
+            for k in range(1, self._epoch + 1):
+                rng = np.random.default_rng(self._seed + k)
+                rng.shuffle(self._order)
 
 
 class AsyncDataSetIterator(DataSetIterator):

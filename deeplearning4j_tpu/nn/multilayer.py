@@ -438,19 +438,26 @@ class MultiLayerNetwork(_LazyScoreMixin):
 
     # --------------------------------------------------------------- output
 
+    def _inference_fn(self):
+        """The pure inference forward fwd(params, bn_state, x) — single
+        source of truth for output(), feed_forward's head, and the compiled
+        artifact export."""
+
+        def fwd(params, bn_state, x):
+            h, _, _ = self._forward(params, bn_state, x, training=False, rng=None)
+            i = len(self.conf.layers) - 1
+            layer = self.conf.layers[i]
+            it = self._input_types[i]
+            if i in self.conf.preprocessors:
+                h = self.conf.preprocessors[i].pre_process(h, it)
+            return layer.forward(params.get(str(i), {}), h, it, training=False, rng=None)
+
+        return fwd
+
     def output(self, x, training: bool = False) -> NDArray:
         """Forward to final layer activations (MultiLayerNetwork.output)."""
         if "output" not in self._jit_cache:
-            def fwd(params, bn_state, x):
-                h, _, _ = self._forward(params, bn_state, x, training=False, rng=None)
-                i = len(self.conf.layers) - 1
-                layer = self.conf.layers[i]
-                it = self._input_types[i]
-                if i in self.conf.preprocessors:
-                    h = self.conf.preprocessors[i].pre_process(h, it)
-                return layer.forward(params.get(str(i), {}), h, it, training=False, rng=None)
-
-            self._jit_cache["output"] = jax.jit(fwd)
+            self._jit_cache["output"] = jax.jit(self._inference_fn())
         xj = jnp.asarray(x.numpy() if hasattr(x, "numpy") else x, self._dtype)
         return NDArray(self._jit_cache["output"](self.params_, self.bn_state, xj))
 
@@ -559,6 +566,15 @@ class MultiLayerNetwork(_LazyScoreMixin):
         self.params_ = new
 
     setParams = set_params
+
+    def export(self, path: str, example_input) -> None:
+        """Compiled-artifact export: StableHLO module + weights zip that
+        reloads and runs WITHOUT this class (serde.compiled.load_compiled)
+        — the reference's C++ GraphExecutioner deployment path (SURVEY §2.9
+        N11/N12)."""
+        from ..serde.compiled import export_multilayer
+
+        export_multilayer(self, path, example_input)
 
     def add_listeners(self, *listeners):
         self.listeners.extend(listeners)

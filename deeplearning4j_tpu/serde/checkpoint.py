@@ -1,0 +1,255 @@
+"""Checkpoint depth (SURVEY §5.4): sharded per-process save/restore, async
+write, data-iterator position capture, and a preemption (SIGTERM) hook.
+
+Reference gap this fills: the reference's CheckpointListener +
+ModelSerializer save a whole model zip synchronously from one JVM and lose
+the iterator position (SURVEY flags that as "worth fixing"); preemption
+safety did not exist. TPU-native shape:
+
+- **Sharded**: each process writes only its addressable shards (with their
+  global index ranges); restore reassembles the global array host-side, and
+  the trainer's normal placement re-shards it. Works 1-process or N-process
+  over a shared filesystem — the orbax layout idea without the dependency.
+- **Async**: the device→host copy happens synchronously (cheap; the arrays
+  are already being donated between steps), the DISK write happens on a
+  background thread so the train loop never blocks on IO.
+- **Iterator position**: any iterator exposing ``state()/set_state()`` (the
+  built-in Array/List iterators do) is captured in train_state.json, so
+  resume continues mid-epoch instead of replaying data.
+- **Preemption**: ``PreemptionHandler`` installs a SIGTERM/SIGINT hook that
+  checkpoints before the process dies (the cloud-TPU eviction contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_STATE_FILE = "train_state.json"
+
+
+def _leaf_paths(tree, prefix=""):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _leaf_paths(tree[k], f"{prefix}{k}/")
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _leaf_paths(v, f"{prefix}{i}#/")
+    else:
+        yield prefix[:-1], tree
+
+
+def _set_leaf(tree, path: str, value):
+    parts = path.split("/")
+    cur = tree
+    for p in parts[:-1]:
+        cur = cur[int(p[:-1])] if p.endswith("#") else cur[p]
+    last = parts[-1]
+    if last.endswith("#"):
+        cur[int(last[:-1])] = value
+    else:
+        cur[last] = value
+
+
+def _gather_local_shards(state_tree) -> Dict[str, Any]:
+    """{leaf_path: [(index_slices, np_data), ...]} for this process."""
+    out: Dict[str, Any] = {}
+    for path, leaf in _leaf_paths(state_tree):
+        if not hasattr(leaf, "dtype"):
+            continue
+        if hasattr(leaf, "addressable_shards"):
+            shards = []
+            for sh in leaf.addressable_shards:
+                if sh.replica_id != 0:
+                    continue  # one copy per replicated shard is enough
+                idx = [[s.start, s.stop] for s in _norm_index(sh.index, leaf.shape)]
+                shards.append((idx, np.asarray(sh.data)))
+            if not shards:  # fully non-addressable replicas: skip
+                continue
+            out[path] = {"shape": list(leaf.shape), "shards": shards}
+        else:
+            a = np.asarray(leaf)
+            out[path] = {"shape": list(a.shape),
+                         "shards": [([[0, n] for n in a.shape], a)]}
+    return out
+
+
+def _norm_index(index, shape):
+    res = []
+    for s, n in zip(index, shape):
+        start = 0 if s.start is None else s.start
+        stop = n if s.stop is None else s.stop
+        res.append(slice(start, stop))
+    return res
+
+
+class TrainingCheckpointer:
+    """save/restore of (net state, train counters, iterator position)."""
+
+    def __init__(self, directory: str, async_write: bool = True):
+        self.dir = directory
+        self.async_write = async_write
+        self._writer: Optional[threading.Thread] = None
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ save
+
+    def save(self, net, iterator=None, tag: str = "latest") -> str:
+        import jax
+
+        ckdir = os.path.join(self.dir, tag)
+        os.makedirs(ckdir, exist_ok=True)
+        state = {"params": net.params_, "updater": net.updater_state,
+                 "bn": net.bn_state}
+        # device→host NOW (snapshot semantics: later train steps donate these
+        # buffers); disk write possibly async
+        local = _gather_local_shards(state)
+        proc = jax.process_index() if jax.process_count() > 1 else 0
+        meta = {
+            "iteration": int(net.iteration),
+            "epoch": int(net.epoch),
+            "score": float(net.score_) if net.score_ == net.score_ else None,
+            "process_count": jax.process_count(),
+        }
+        if iterator is not None and hasattr(iterator, "state"):
+            meta["iterator"] = iterator.state()
+
+        def write():
+            # the save id (the iteration — identical on every process of a
+            # synchronous SPMD run) is stamped into every shard AND the meta
+            # file; restore refuses mismatches, so a kill between the two
+            # os.replace calls can't pair new weights with stale counters
+            blob = {"__save_id__": np.asarray(meta["iteration"], np.int64)}
+            for path, entry in local.items():
+                for si, (idx, data) in enumerate(entry["shards"]):
+                    key = f"{path}|{si}"
+                    blob[key] = data
+                    blob[f"{key}|idx"] = np.asarray(idx, np.int64)
+                    blob[f"{key}|shape"] = np.asarray(entry["shape"], np.int64)
+            tmp = os.path.join(ckdir, f"shard_{proc}.npz.tmp")
+            final = os.path.join(ckdir, f"shard_{proc}.npz")
+            with open(tmp, "wb") as f:
+                np.savez(f, **blob)
+            os.replace(tmp, final)  # per-file atomic
+            if proc == 0:
+                tmp_m = os.path.join(ckdir, _STATE_FILE + ".tmp")
+                with open(tmp_m, "w") as f:
+                    json.dump(meta, f)
+                os.replace(tmp_m, os.path.join(ckdir, _STATE_FILE))
+
+        self.wait()  # one in-flight write at a time
+        if self.async_write:
+            # non-daemon: a clean interpreter exit drains the write instead
+            # of silently discarding a checkpoint save() already returned for
+            self._writer = threading.Thread(target=write, daemon=False)
+            self._writer.start()
+        else:
+            write()
+        return ckdir
+
+    def wait(self):
+        """Block until the in-flight async write (if any) is durable."""
+        if self._writer is not None:
+            self._writer.join()
+            self._writer = None
+
+    # --------------------------------------------------------------- restore
+
+    def restore(self, net, iterator=None, tag: str = "latest") -> bool:
+        """Reassemble global arrays from every shard file present and load
+        them into the net (+ counters, + iterator position). Returns False if
+        no checkpoint exists."""
+        import jax.numpy as jnp
+
+        ckdir = os.path.join(self.dir, tag)
+        state_path = os.path.join(ckdir, _STATE_FILE)
+        if not os.path.exists(state_path):
+            return False
+        with open(state_path) as f:
+            meta = json.load(f)
+        shard_files = sorted(f for f in os.listdir(ckdir)
+                             if f.startswith("shard_") and f.endswith(".npz"))
+        expected = int(meta.get("process_count", 1))
+        if len(shard_files) < expected:
+            raise ValueError(
+                f"partial checkpoint in {ckdir}: {len(shard_files)} shard "
+                f"files for a {expected}-process save — a process was likely "
+                "killed mid-write; refusing to restore silently-zeroed weights")
+        assembled: Dict[str, np.ndarray] = {}
+        for fname in shard_files:
+            with np.load(os.path.join(ckdir, fname)) as npz:
+                sid = int(npz["__save_id__"]) if "__save_id__" in npz.files else None
+                if sid is not None and sid != int(meta["iteration"]):
+                    raise ValueError(
+                        f"checkpoint {ckdir}/{fname} save id {sid} does not "
+                        f"match metadata iteration {meta['iteration']} — torn "
+                        "checkpoint (kill between shard and metadata writes)")
+                keys = [k for k in npz.files if "|" in k and not k.endswith("|idx")
+                        and not k.endswith("|shape")]
+                for key in keys:
+                    path = key.rsplit("|", 1)[0]
+                    shape = tuple(npz[f"{key}|shape"])
+                    idx = npz[f"{key}|idx"]
+                    if path not in assembled:
+                        assembled[path] = np.zeros(shape, npz[key].dtype)
+                    sl = tuple(slice(a, b) for a, b in idx)
+                    assembled[path][sl] = npz[key]
+        tops = {"params": net.params_, "updater": net.updater_state,
+                "bn": net.bn_state}
+        for path, arr in assembled.items():
+            top, rest = path.split("/", 1)
+            _set_leaf(tops[top], rest, jnp.asarray(arr))
+        net.iteration = meta["iteration"]
+        net.epoch = meta["epoch"]
+        if iterator is not None and "iterator" in meta and hasattr(iterator, "set_state"):
+            iterator.set_state(meta["iterator"])
+        return True
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT → checkpoint-before-death (cloud preemption contract).
+
+    Usage: ``PreemptionHandler(ckpt, net, iterator).install()``; on signal it
+    saves synchronously, then re-raises the default behavior (exit) unless
+    ``swallow=True`` (tests)."""
+
+    def __init__(self, checkpointer: TrainingCheckpointer, net, iterator=None,
+                 signals=(signal.SIGTERM,), swallow: bool = False):
+        self.ck = checkpointer
+        self.net = net
+        self.iterator = iterator
+        self.signals = signals
+        self.swallow = swallow
+        self.fired = False
+        self._prev: Dict[int, Any] = {}
+
+    def install(self) -> "PreemptionHandler":
+        for sig in self.signals:
+            self._prev[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def uninstall(self):
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev = {}
+
+    def _handle(self, signum, frame):
+        self.fired = True
+        was_async = self.ck.async_write
+        self.ck.async_write = False  # the process is dying: write NOW
+        try:
+            self.ck.save(self.net, self.iterator, tag="preempt")
+        finally:
+            self.ck.async_write = was_async
+        if not self.swallow:
+            prev = self._prev.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)

@@ -1,0 +1,122 @@
+"""Compiled-artifact export/reload (SURVEY §2.9 N11/N12): StableHLO module +
+weights zip executes WITHOUT the Python model object."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.serde.compiled import (
+    CompiledModel,
+    _flatten,
+    _unflatten,
+    load_compiled,
+)
+
+
+def _mlp():
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import (
+        BatchNormalization,
+        DenseLayer,
+        InputType,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .seed(3)
+        .updater(Adam(1e-2))
+        .list()
+        .layer(DenseLayer(n_in=6, n_out=16, activation="relu"))
+        .layer(BatchNormalization())
+        .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(6))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def test_flatten_roundtrip():
+    tree = {"a": {"b": np.ones((2,)), "c": np.zeros((3,))},
+            "lst": [np.full((1,), 2.0), {"d": np.full((2, 2), 3.0)}]}
+    back = _unflatten(_flatten(tree))
+    assert set(back) == {"a", "lst"}
+    np.testing.assert_array_equal(back["lst"][1]["d"], tree["lst"][1]["d"])
+
+
+def test_mln_export_reload_matches(tmp_path):
+    net = _mlp()
+    # train a little so bn stats + params are non-trivial
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 6).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 16)]
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    for _ in range(3):
+        net._fit_batch(DataSet(x, y))
+
+    want = np.asarray(net.output(x).numpy())
+    p = str(tmp_path / "model.zip")
+    net.export(p, x)
+    loaded = load_compiled(p)
+    assert isinstance(loaded, CompiledModel)
+    got = np.asarray(loaded(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert loaded.metadata["model_type"] == "MultiLayerNetwork"
+
+
+def test_export_without_batchnorm(tmp_path):
+    """bn_state == {} must survive the flatten/unflatten round trip (empty
+    containers are part of the export calling convention)."""
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2)).list()
+        .layer(DenseLayer(n_in=5, n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(5))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.RandomState(4).randn(3, 5).astype(np.float32)
+    p = str(tmp_path / "nobn.zip")
+    net.export(p, x)
+    got = np.asarray(load_compiled(p)(x))
+    np.testing.assert_allclose(got, np.asarray(net.output(x).numpy()),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_samediff_export_reload_matches(tmp_path):
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (4, 3))
+    w = sd.var("w", np.random.RandomState(1).randn(3, 5).astype(np.float32))
+    b = sd.var("b", np.zeros(5, np.float32))
+    h = sd.op("relu", sd.nn().linear(x, w, b))
+    out = sd.op("softmax", h)
+
+    ph = {"x": np.random.RandomState(2).randn(4, 3).astype(np.float32)}
+    want = np.asarray(sd.output(ph, out.name)[out.name])
+
+    p = str(tmp_path / "sd.zip")
+    sd.save_compiled(p, ph, out.name)
+    loaded = load_compiled(p)
+    got = loaded({"x": jnp.asarray(ph["x"])})[out.name]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+def test_artifact_is_self_contained(tmp_path):
+    """The zip holds everything: module bytes, weights, metadata."""
+    import zipfile
+
+    net = _mlp()
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    p = str(tmp_path / "m.zip")
+    net.export(p, x)
+    with zipfile.ZipFile(p) as z:
+        names = set(z.namelist())
+    assert names == {"model.stablehlo", "weights.npz", "metadata.json"}
