@@ -1,0 +1,124 @@
+"""SameDiff control flow: if/while as compiler-friendly subgraph ops.
+
+Reference: TF-style frames in ``org.nd4j.autodiff.samediff.internal.
+AbstractSession`` + the Switch/Merge/Enter/Exit logic ops (SURVEY §2.2
+J11/J12) — a host-side interpreter tracks frame/iteration bookkeeping per
+node. TPU inversion: a conditional is ONE ``lax.cond`` and a loop is ONE
+``lax.while_loop`` inside the same compiled graph — no per-iteration host
+round trips, no frame bookkeeping; XLA compiles the whole loop body once.
+
+Subgraphs are real nested :class:`SameDiff` graphs (built by user lambdas),
+stored in the op node's kwargs and serialized recursively with the parent —
+the FlatBuffers-scope story (§2.1 N11 logic ops) without a second format.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+IF_OP = "__if__"
+WHILE_OP = "__while__"
+CONTROL_OPS = (IF_OP, WHILE_OP)
+
+
+def build_subgraph(fn: Callable, n_args: int) -> Dict[str, Any]:
+    """Run a user body lambda ``fn(sub_sd, *arg_vars) -> var|tuple`` against
+    a fresh nested SameDiff; returns the stored-subgraph dict."""
+    from .samediff import SameDiff
+
+    sub = SameDiff.create()
+    args = [sub.placeholder(f"__arg{i}", None) for i in range(n_args)]
+    outs = fn(sub, *args)
+    outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+    return {
+        "graph": sub,
+        "args": [a.name for a in args],
+        "outputs": [o.name for o in outs],
+    }
+
+
+def subgraph_callable(subg: Dict[str, Any]) -> Callable:
+    """(arg arrays) -> tuple(output arrays): replays the nested graph —
+    traceable, so it nests inside lax.cond/while_loop."""
+    sub = subg["graph"]
+    traced = sub._trace_fn(tuple(subg["outputs"]))
+
+    def call(*vals):
+        ph = dict(zip(subg["args"], vals))
+        out = traced(dict(sub.arrays), ph)
+        return tuple(out[o] for o in subg["outputs"])
+
+    return call
+
+
+def apply_if(kwargs: Dict[str, Any], pred, *args):
+    t = subgraph_callable(kwargs["true"])
+    f = subgraph_callable(kwargs["false"])
+    res = jax.lax.cond(jnp.asarray(pred).astype(bool).reshape(()),
+                       lambda ops: t(*ops), lambda ops: f(*ops), tuple(args))
+    return res
+
+
+def apply_while(kwargs: Dict[str, Any], *loop_vars):
+    cond = subgraph_callable(kwargs["cond"])
+    body = subgraph_callable(kwargs["body"])
+    res = jax.lax.while_loop(
+        lambda vs: jnp.asarray(cond(*vs)[0]).astype(bool).reshape(()),
+        lambda vs: tuple(body(*vs)),
+        tuple(jnp.asarray(v) for v in loop_vars))
+    return res
+
+
+# ------------------------------------------------------------- serialization
+
+
+def subgraph_to_json(subg: Dict[str, Any]) -> Dict[str, Any]:
+    from .samediff import _json_safe
+
+    sub = subg["graph"]
+    return {
+        "__subgraph__": True,
+        "args": subg["args"],
+        "outputs": subg["outputs"],
+        "vars": [{"name": v.name, "type": v.var_type,
+                  "shape": list(v.shape) if v.shape else None}
+                 for v in sub.vars.values()],
+        "ops": [{"op": n.op_name, "inputs": n.inputs, "outputs": n.outputs,
+                 "kwargs": _json_safe(n.kwargs), "n_outputs": n.n_outputs}
+                for n in sub.ops],
+        "arrays": {k: _small_array_json(k, v) for k, v in sub.arrays.items()},
+    }
+
+
+_SUBGRAPH_CONST_MAX = 65536
+
+
+def _small_array_json(name: str, v):
+    a = np.asarray(v)
+    if a.size > _SUBGRAPH_CONST_MAX:
+        raise ValueError(
+            f"subgraph constant '{name}' has {a.size} elements; control-flow "
+            "subgraph constants serialize into graph.json (text) — keep big "
+            "tensors in the parent graph and pass them in as loop vars / "
+            "if_cond inputs instead")
+    return {"data": a.tolist(), "dtype": str(a.dtype)}
+
+
+def subgraph_from_json(d: Dict[str, Any]) -> Dict[str, Any]:
+    from .samediff import OpNode, SameDiff, SDVariable, _json_decode
+
+    sub = SameDiff.create()
+    for vd in d["vars"]:
+        v = SDVariable(sub, vd["name"], vd["type"],
+                       tuple(vd["shape"]) if vd["shape"] else None)
+        sub.vars[vd["name"]] = v
+    for n in d["ops"]:
+        sub.ops.append(OpNode(n["op"], n["inputs"], n["outputs"],
+                              _json_decode(n["kwargs"]), n["n_outputs"]))
+    sub.arrays = {k: jnp.asarray(np.asarray(e["data"], e["dtype"]))
+                  for k, e in d["arrays"].items()}
+    return {"graph": sub, "args": d["args"], "outputs": d["outputs"]}

@@ -246,7 +246,10 @@ class SameDiff:
 
     def _add_op(self, op_name: str, inputs: List[SDVariable], *, name: Optional[str] = None,
                 kwargs: Optional[Dict[str, Any]] = None, n_outputs: int = 1):
-        get_op(op_name)  # validate now
+        from .control_flow import CONTROL_OPS
+
+        if op_name not in CONTROL_OPS:
+            get_op(op_name)  # validate now
         if name is not None and name in self.vars:
             raise ValueError(f"variable '{name}' already exists")
         out_names = ([name] if name and n_outputs == 1
@@ -266,6 +269,48 @@ class SameDiff:
         """Generic escape hatch: sd.op("gelu", x)."""
         return self._add_op(op_name, [self._lift(i) for i in inputs], name=name,
                             kwargs=kwargs, n_outputs=n_outputs)
+
+    # ------------------------------------------------------- control flow
+
+    def if_cond(self, pred, true_fn, false_fn, inputs=(), *, name: Optional[str] = None):
+        """SameDiff.ifCond (J11 control flow): ONE lax.cond in the compiled
+        graph. ``true_fn``/``false_fn``: ``lambda sub, *args -> var|tuple``
+        building nested subgraphs over ``inputs``; both must return the same
+        arity/shapes (XLA branch contract)."""
+        from .control_flow import IF_OP, build_subgraph
+
+        inputs = list(inputs)
+        t = build_subgraph(true_fn, len(inputs))
+        f = build_subgraph(false_fn, len(inputs))
+        if len(t["outputs"]) != len(f["outputs"]):
+            raise ValueError(
+                f"if_cond branches return different arities: "
+                f"{len(t['outputs'])} vs {len(f['outputs'])}")
+        n_out = len(t["outputs"])
+        return self._add_op(
+            IF_OP, [self._lift(pred)] + [self._lift(i) for i in inputs],
+            name=name, kwargs={"true": t, "false": f}, n_outputs=n_out)
+
+    ifCond = if_cond
+
+    def while_loop(self, loop_vars, cond_fn, body_fn, *, name: Optional[str] = None):
+        """SameDiff.whileLoop (TF-style frames → ONE lax.while_loop).
+        ``cond_fn(sub, *vars) -> scalar bool var``; ``body_fn(sub, *vars) ->
+        vars'`` (same arity/shapes — the loop-carried contract)."""
+        from .control_flow import WHILE_OP, build_subgraph
+
+        loop_vars = list(loop_vars)
+        cond = build_subgraph(cond_fn, len(loop_vars))
+        body = build_subgraph(body_fn, len(loop_vars))
+        if len(body["outputs"]) != len(loop_vars):
+            raise ValueError(
+                f"while_loop body returns {len(body['outputs'])} values for "
+                f"{len(loop_vars)} loop vars (must match)")
+        return self._add_op(
+            WHILE_OP, [self._lift(v) for v in loop_vars], name=name,
+            kwargs={"cond": cond, "body": body}, n_outputs=len(loop_vars))
+
+    whileLoop = while_loop
 
     # namespaces (SDNN/SDMath/... parity) built in namespaces.py
     def math(self):
@@ -308,13 +353,22 @@ class SameDiff:
         op_list = [n for n in self.ops if any(o in needed for o in n.outputs)]
 
         def fn(var_arrays: Dict[str, Any], placeholders: Dict[str, Any]):
+            from .control_flow import IF_OP, WHILE_OP, apply_if, apply_while
+
             env: Dict[str, Any] = {}
             env.update(var_arrays)
             env.update(placeholders)
             for node in op_list:
-                f = get_op(node.op_name)
                 args = [env[i] for i in node.inputs]
-                res = f(*args, **node.kwargs)
+                if node.op_name == IF_OP:
+                    res = apply_if(node.kwargs, *args)
+                    res = res if node.n_outputs > 1 else res[0]
+                elif node.op_name == WHILE_OP:
+                    res = apply_while(node.kwargs, *args)
+                    res = res if node.n_outputs > 1 else res[0]
+                else:
+                    f = get_op(node.op_name)
+                    res = f(*args, **node.kwargs)
                 if node.n_outputs == 1:
                     env[node.outputs[0]] = res
                 else:
@@ -454,6 +508,14 @@ class SameDiff:
 
     # ---------------------------------------------------------------- serde
 
+    def save_compiled(self, path: str, placeholders, outputs) -> None:
+        """Compiled-artifact export (StableHLO + weights zip): the whole-graph
+        forward for ``outputs``, reloadable WITHOUT this SameDiff object —
+        the libnd4j GraphExecutioner deployment path (SURVEY §2.9 N11/N12)."""
+        from ..serde.compiled import export_samediff
+
+        export_samediff(self, path, placeholders, outputs)
+
     def save(self, path: str, save_updater_state: bool = False):
         """Zip: graph.json (structure) + arrays.npz (+updater.npz).
         (Reference: FlatBuffers zip via FlatBuffersMapper — J15; format
@@ -568,6 +630,11 @@ def _json_safe(v):
     Dtypes serialize as ``{"__dtype__": "float32"}``; ``_json_decode``
     restores them on load."""
     if isinstance(v, dict):
+        if "graph" in v and "args" in v and "outputs" in v and hasattr(
+                v["graph"], "ops"):  # nested control-flow subgraph
+            from .control_flow import subgraph_to_json
+
+            return subgraph_to_json(v)
         return {k: _json_safe(x) for k, x in v.items()}
     if isinstance(v, (list, tuple)):
         return [_json_safe(x) for x in v]
@@ -587,6 +654,10 @@ def _json_safe(v):
 
 def _json_decode(v):
     if isinstance(v, dict):
+        if v.get("__subgraph__"):
+            from .control_flow import subgraph_from_json
+
+            return subgraph_from_json(v)
         if "__dtype__" in v and len(v) == 1:
             return np.dtype(v["__dtype__"])
         if "__ndarray__" in v:

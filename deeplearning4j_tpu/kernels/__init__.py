@@ -17,6 +17,7 @@ from .attention import (
     flash_attention,
     mha_reference,
     ring_attention,
+    ulysses_attention,
 )
 
 __all__ = [
@@ -24,4 +25,5 @@ __all__ = [
     "flash_attention",
     "mha_reference",
     "ring_attention",
+    "ulysses_attention",
 ]

@@ -413,6 +413,42 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False, scale: Opti
     return (acc / l).astype(q.dtype)
 
 
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None, key_mask=None,
+                      inner_impl: str = "auto"):
+    """Ulysses (DeepSpeed-style) sequence parallelism: two all-to-alls swap
+    the sequence sharding for a HEAD sharding, every device computes FULL
+    attention for its head group, then the output swaps back.
+
+    Call INSIDE shard_map with sequence sharded over ``axis_name``:
+    q/k/v local [B, H, T_local, D], H divisible by the axis size. Complements
+    :func:`ring_attention` (SURVEY §5.7/§2.10 SP row: ring + Ulysses are the
+    two mandated sequence-parallel modes): Ulysses costs 2 all-to-alls
+    (bandwidth-optimal on all-to-all-capable ICI) vs the ring's P-step
+    ppermute pipeline; the ring wins at very long T where even T×T/P tiles
+    blow HBM, Ulysses wins on latency for moderate T.
+    """
+    n = jax.lax.axis_size(axis_name)
+    H = q.shape[1]
+    if H % n:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by axis size ({n})")
+    # [B, H, T/P, D] → [B, H/P, T, D]: split heads over the axis, gather time
+    q, k, v = (jax.lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True) for t in (q, k, v))
+    mask = None
+    if key_mask is not None:
+        # each device now attends over the FULL sequence → full mask needed
+        gathered = jax.lax.all_gather(key_mask, axis_name)  # [P, B, T_local]
+        mask = jnp.moveaxis(gathered, 0, 1).reshape(key_mask.shape[0], -1)  # [B, T]
+    if mask is not None:
+        out = mha_reference(q, k, v, mask, causal=causal, scale=scale)
+    else:
+        out = dot_product_attention(q, k, v, causal=causal, scale=scale,
+                                    impl=inner_impl)
+    # [B, H/P, T, D] → [B, H, T/P, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
 def dot_product_attention(q, k, v, mask=None, *, causal=False, scale=None, impl: str = "auto"):
     """Front door used by nn layers / the transformer. impl: auto|xla|flash.
 

@@ -1,0 +1,104 @@
+"""Mixture-of-Experts FFN with expert parallelism (SURVEY §2.10 EP row).
+
+The reference has no MoE; the task bar is the modern set. TPU-native shape =
+the GShard/Mesh-TensorFlow formulation: routing is DENSE einsum algebra
+(dispatch/combine tensors, static capacity) so the whole layer is three
+MXU einsums + a vmapped expert FFN — no scatter, no dynamic shapes; the
+expert dimension shards over the mesh ``expert`` axis with plain
+PartitionSpecs and GSPMD inserts the all-to-alls.
+
+Top-k gating with capacity dropping + the standard load-balancing auxiliary
+loss (Shazeer et al.; fraction-of-tokens × fraction-of-router-prob per
+expert, scaled by E).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    d_model: int = 128
+    d_ff: int = 512
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    expert_axis: str = "expert"
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(1, int(self.top_k * n_tokens * self.capacity_factor
+                          / self.n_experts + 0.999))
+
+
+def init_moe_params(key, cfg: MoEConfig, dtype=jnp.float32) -> Dict[str, Any]:
+    kg, k1, k2 = jax.random.split(key, 3)
+    E, D, F = cfg.n_experts, cfg.d_model, cfg.d_ff
+    s = 0.02
+    return {
+        "wg": (jax.random.normal(kg, (D, E)) * s).astype(dtype),
+        "w1": (jax.random.normal(k1, (E, D, F)) * s).astype(dtype),
+        "b1": jnp.zeros((E, F), dtype),
+        "w2": (jax.random.normal(k2, (E, F, D)) * s).astype(dtype),
+        "b2": jnp.zeros((E, D), dtype),
+    }
+
+
+def moe_partition_specs(cfg: MoEConfig) -> Dict[str, Any]:
+    """Experts shard over the expert axis; the router is replicated."""
+    e = cfg.expert_axis
+    return {"wg": P(), "w1": P(e, None, None), "b1": P(e, None),
+            "w2": P(e, None, None), "b2": P(e, None)}
+
+
+def _topk_dispatch(gates, k: int, capacity: int):
+    """gates [N, E] → (combine [N, E, C], dispatch [N, E, C], aux_loss).
+
+    Slot-major priority: all tokens' 1st choices claim capacity before any
+    2nd choice (GShard's policy), positions via cumsum — pure dense algebra.
+    """
+    N, E = gates.shape
+    topv, topi = jax.lax.top_k(gates, k)                      # [N, k]
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(topi, E, dtype=gates.dtype)       # [N, k, E]
+    slot_major = onehot.transpose(1, 0, 2).reshape(k * N, E)
+    pos = jnp.cumsum(slot_major, axis=0) - slot_major          # [kN, E]
+    pos = pos.reshape(k, N, E).transpose(1, 0, 2)              # [N, k, E]
+    pos_in_expert = (pos * onehot).sum(-1)                     # [N, k]
+    keep = (pos_in_expert < capacity).astype(gates.dtype)      # [N, k]
+    cap_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=gates.dtype)  # [N,k,C]
+    combine = jnp.einsum("nke,nkc,nk->nec", onehot, cap_oh, topv * keep)
+    dispatch = jnp.einsum("nke,nkc,nk->nec", onehot, cap_oh, keep)
+    # load-balance aux: E * Σ_e mean_tokens(frac routed to e) * mean router prob
+    me = onehot[:, 0, :].mean(axis=0)                          # 1st-choice fraction
+    ce = gates.mean(axis=0)
+    aux = E * jnp.sum(me * ce)
+    return combine, dispatch, aux
+
+
+def moe_ffn(params, x, cfg: MoEConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x [B, T, D] → (y [B, T, D], aux_loss scalar)."""
+    B, T, D = x.shape
+    N = B * T
+    xt = x.reshape(N, D)
+    gates = jax.nn.softmax(xt @ params["wg"].astype(x.dtype), axis=-1)
+    C = cfg.capacity(N)
+    combine, dispatch, aux = _topk_dispatch(gates, cfg.top_k, C)
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xt)  # [E,C,D]
+
+    def ffn(e_in, w1, b1, w2, b2):
+        h = jax.nn.gelu(e_in @ w1 + b1)
+        return h @ w2 + b2
+
+    expert_out = jax.vmap(ffn)(expert_in, params["w1"].astype(x.dtype),
+                               params["b1"].astype(x.dtype),
+                               params["w2"].astype(x.dtype),
+                               params["b2"].astype(x.dtype))   # [E, C, D]
+    y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+    return y.reshape(B, T, D), aux

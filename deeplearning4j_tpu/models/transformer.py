@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..kernels.attention import dot_product_attention, ring_attention
+from ..kernels.attention import dot_product_attention, ring_attention, ulysses_attention
 
 
 @dataclasses.dataclass
@@ -41,7 +41,7 @@ class TransformerConfig:
     dropout: float = 0.1
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16  # bf16 matmuls on the MXU, fp32 master params
-    attn_impl: str = "auto"          # auto | xla | flash | ring
+    attn_impl: str = "auto"          # auto | xla | flash | ring | ulysses
     sequence_axis: Optional[str] = None  # mesh axis for ring attention ("sp")
     remat: bool = False              # jax.checkpoint each block (HBM for FLOPs)
     norm_position: str = "pre"       # "pre" (GPT-style, default) | "post" (original BERT)
@@ -157,9 +157,12 @@ def _layer_norm(x, scale, bias, eps=1e-12):
 
 
 def _attention(cfg: TransformerConfig, q, k, v, pad_mask):
-    if cfg.attn_impl == "ring" and cfg.sequence_axis:
-        # sequence-sharded ring attention inside shard_map; head axis may be
+    if cfg.attn_impl in ("ring", "ulysses") and cfg.sequence_axis:
+        # sequence-sharded attention inside shard_map; head axis may be
         # tp-sharded at the same time — specs reference only present axes.
+        # ring = ppermute pipeline (longest T); ulysses = 2 all-to-alls
+        # swapping seq↔head sharding (lower latency at moderate T).
+        kernel = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
         mesh = jax.sharding.get_abstract_mesh()
         tp = "tp" if "tp" in mesh.axis_names else None
         dp = "dp" if "dp" in mesh.axis_names else None
@@ -167,16 +170,21 @@ def _attention(cfg: TransformerConfig, q, k, v, pad_mask):
         if pad_mask is not None:
             mspec = P(dp, cfg.sequence_axis)
             f = jax.shard_map(
-                lambda a, b, c, m: ring_attention(
+                lambda a, b, c, m: kernel(
                     a, b, c, axis_name=cfg.sequence_axis, causal=cfg.causal, key_mask=m),
                 mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
             )
             return f(q, k, v, pad_mask)
         f = jax.shard_map(
-            functools.partial(ring_attention, axis_name=cfg.sequence_axis, causal=cfg.causal),
+            functools.partial(kernel, axis_name=cfg.sequence_axis, causal=cfg.causal),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         )
         return f(q, k, v)
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise ValueError(
+            f"attn_impl={cfg.attn_impl!r} requires sequence_axis (a mesh axis "
+            "name) — silently falling back to dense attention would fake "
+            "sequence parallelism")
     impl = cfg.attn_impl if cfg.attn_impl in ("xla", "flash", "auto") else "auto"
     return dot_product_attention(q, k, v, pad_mask, causal=cfg.causal, impl=impl)
 

@@ -438,19 +438,23 @@ class MultiLayerNetwork(_LazyScoreMixin):
 
     # --------------------------------------------------------------- output
 
+    def _head_forward(self, params, h):
+        """Final layer (preprocessor + forward) applied to the last hidden
+        state — shared by output()/export and feed_forward()."""
+        i = len(self.conf.layers) - 1
+        layer = self.conf.layers[i]
+        it = self._input_types[i]
+        if i in self.conf.preprocessors:
+            h = self.conf.preprocessors[i].pre_process(h, it)
+        return layer.forward(params.get(str(i), {}), h, it, training=False, rng=None)
+
     def _inference_fn(self):
         """The pure inference forward fwd(params, bn_state, x) — single
-        source of truth for output(), feed_forward's head, and the compiled
-        artifact export."""
+        source of truth for output() and the compiled artifact export."""
 
         def fwd(params, bn_state, x):
             h, _, _ = self._forward(params, bn_state, x, training=False, rng=None)
-            i = len(self.conf.layers) - 1
-            layer = self.conf.layers[i]
-            it = self._input_types[i]
-            if i in self.conf.preprocessors:
-                h = self.conf.preprocessors[i].pre_process(h, it)
-            return layer.forward(params.get(str(i), {}), h, it, training=False, rng=None)
+            return self._head_forward(params, h)
 
         return fwd
 
@@ -465,13 +469,7 @@ class MultiLayerNetwork(_LazyScoreMixin):
         """All layer activations (MultiLayerNetwork.feedForward)."""
         xj = jnp.asarray(x.numpy() if hasattr(x, "numpy") else x, self._dtype)
         acts, _, _ = self._forward(self.params_, self.bn_state, xj, training=False, rng=None, collect=True)
-        i = len(self.conf.layers) - 1
-        layer = self.conf.layers[i]
-        h = acts[-1] if acts else xj
-        it = self._input_types[i]
-        if i in self.conf.preprocessors:
-            h = self.conf.preprocessors[i].pre_process(h, it)
-        out = layer.forward(self.params_.get(str(i), {}), h, it, training=False, rng=None)
+        out = self._head_forward(self.params_, acts[-1] if acts else xj)
         return [NDArray(a) for a in acts] + [NDArray(out)]
 
     def score(self, ds: Optional[DataSet] = None) -> float:

@@ -166,6 +166,7 @@ class TrainingCheckpointer:
         no checkpoint exists."""
         import jax.numpy as jnp
 
+        self.wait()  # never read past our own in-flight async write
         ckdir = os.path.join(self.dir, tag)
         state_path = os.path.join(ckdir, _STATE_FILE)
         if not os.path.exists(state_path):

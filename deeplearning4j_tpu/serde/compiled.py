@@ -29,6 +29,7 @@ _FORMAT_VERSION = 1
 
 _EMPTY_DICT = "__EMPTY_DICT__"
 _EMPTY_LIST = "__EMPTY_LIST__"
+_TUPLE = "__TUPLE__"
 
 
 def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
@@ -43,6 +44,9 @@ def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
         for k in sorted(tree):
             out.update(_flatten(tree[k], f"{prefix}{k}/"))
     elif isinstance(tree, (list, tuple)):
+        if isinstance(tree, tuple):
+            # jax.export's calling convention distinguishes tuple vs list
+            out[prefix + _TUPLE] = np.zeros(0)
         if not tree:
             out[prefix + _EMPTY_LIST] = np.zeros(0)
         for i, v in enumerate(tree):
@@ -61,18 +65,20 @@ def _unflatten(flat: Dict[str, np.ndarray]):
             cur = cur.setdefault(p, {})
         if parts[-1] == _EMPTY_DICT:
             continue  # the setdefault chain already created the empty dict
-        if parts[-1] == _EMPTY_LIST:
-            cur[_EMPTY_LIST] = True
+        if parts[-1] in (_EMPTY_LIST, _TUPLE):
+            cur[parts[-1]] = True
             continue
         cur[parts[-1]] = arr
 
     def fix(node):
         if not isinstance(node, dict):
             return node
+        is_tuple = bool(node.pop(_TUPLE, None))
         if node.pop(_EMPTY_LIST, None):
-            return []
+            return () if is_tuple else []
         if node and all(k.endswith("#") for k in node):
-            return [fix(node[f"{i}#"]) for i in range(len(node))]
+            seq = [fix(node[f"{i}#"]) for i in range(len(node))]
+            return tuple(seq) if is_tuple else seq
         return {k: fix(v) for k, v in node.items()}
 
     return fix(root)

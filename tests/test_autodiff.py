@@ -128,3 +128,88 @@ def test_op_registry_size_and_validation_gate():
     OpValidation.assert_coverage(["add", "matmul", "softmax"])
     with pytest.raises(AssertionError):
         OpValidation.assert_coverage(["some_untested_op_name"])
+
+
+class TestControlFlow:
+    """SameDiff if/while (SURVEY §2.2 J11 control flow → lax.cond/while_loop)."""
+
+    def test_if_cond_branches(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (3,))
+        pred = sd.placeholder("p", ())
+        out = sd.if_cond(
+            pred,
+            lambda sub, a: sub.op("mul", a, sub.constant("two", 2.0)),
+            lambda sub, a: sub.op("neg", a),
+            inputs=[x], name="branched")
+        xs = np.array([1.0, -2.0, 3.0], np.float32)
+        hi = sd.output({"x": xs, "p": np.asarray(1.0)}, "branched")["branched"]
+        lo = sd.output({"x": xs, "p": np.asarray(0.0)}, "branched")["branched"]
+        np.testing.assert_allclose(np.asarray(hi), xs * 2)
+        np.testing.assert_allclose(np.asarray(lo), -xs)
+
+    def test_while_loop_accumulates(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        i0 = sd.constant("i0", np.asarray(0.0, np.float32))
+        acc0 = sd.placeholder("acc0", ())
+        outs = sd.while_loop(
+            [i0, acc0],
+            lambda sub, i, acc: sub.op("lt", i, sub.constant("n", 5.0)),
+            lambda sub, i, acc: (sub.op("add", i, sub.constant("one", 1.0)),
+                                 sub.op("add", acc, i)),
+            name="loop")
+        res = sd.output({"acc0": np.asarray(0.0, np.float32)},
+                        [o.name for o in outs])
+        # sum of 0..4 = 10, i ends at 5
+        np.testing.assert_allclose(float(np.asarray(res[outs[0].name])), 5.0)
+        np.testing.assert_allclose(float(np.asarray(res[outs[1].name])), 10.0)
+
+    def test_while_arity_mismatch_raises(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        a = sd.constant("a", np.asarray(0.0, np.float32))
+        with pytest.raises(ValueError, match="loop vars"):
+            sd.while_loop([a],
+                          lambda sub, i: sub.op("lt", i, sub.constant("n", 3.0)),
+                          lambda sub, i: (i, i))
+
+    def test_control_flow_serialization_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (3,))
+        p = sd.placeholder("p", ())
+        sd.if_cond(p,
+                   lambda sub, a: sub.op("mul", a, sub.constant("three", 3.0)),
+                   lambda sub, a: sub.op("abs", a),
+                   inputs=[x], name="cf")
+        path = str(tmp_path / "cf.zip")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        xs = np.array([-1.0, 2.0, -3.0], np.float32)
+        got = sd2.output({"x": xs, "p": np.asarray(1.0)}, "cf")["cf"]
+        np.testing.assert_allclose(np.asarray(got), xs * 3)
+        got0 = sd2.output({"x": xs, "p": np.asarray(0.0)}, "cf")["cf"]
+        np.testing.assert_allclose(np.asarray(got0), np.abs(xs))
+
+    def test_grad_through_cond(self):
+        """Training graphs can contain conditionals (grad flows through the
+        taken branch)."""
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        w = sd.var("w", np.asarray([2.0, 3.0], np.float32))
+        p = sd.placeholder("p", ())
+        y = sd.if_cond(p,
+                       lambda sub, a: sub.op("mul", a, a),
+                       lambda sub, a: a,
+                       inputs=[w], name="y")
+        loss = sd.op("reduce_sum", y, name="loss")
+        sd.set_loss_variables("loss")
+        grads = sd.calculate_gradients({"p": np.asarray(1.0)}, ["w"])
+        np.testing.assert_allclose(np.asarray(grads["w"]), [4.0, 6.0])

@@ -106,3 +106,53 @@ def test_flash_backward_rectangular_decode():
                   argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(causal):
+    """All-to-all sequence parallelism == full attention (SURVEY §2.10 SP)."""
+    from deeplearning4j_tpu.kernels import ulysses_attention
+
+    q, k, v = _qkv((2, 4, 256, 32))
+    ref = mha_reference(q, k, v, causal=causal)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    f = jax.shard_map(
+        lambda a, b, c: ulysses_attention(a, b, c, axis_name="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None),
+    )
+    out = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_attention_respects_key_mask():
+    from deeplearning4j_tpu.kernels import ulysses_attention
+
+    q, k, v = _qkv((2, 4, 64, 16))
+    rs = np.random.RandomState(5)
+    mask = jnp.asarray((rs.rand(2, 64) > 0.3).astype(np.float32))
+    ref = mha_reference(q, k, v, mask)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    f = jax.shard_map(
+        lambda a, b, c, m: ulysses_attention(a, b, c, axis_name="sp", key_mask=m),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3 + (P(None, "sp"),),
+        out_specs=P(None, None, "sp", None),
+    )
+    out = f(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_heads_divisibility_error():
+    from deeplearning4j_tpu.kernels import ulysses_attention
+
+    q, k, v = _qkv((1, 3, 64, 16))  # 3 heads, 4 devices
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    f = jax.shard_map(
+        lambda a, b, c: ulysses_attention(a, b, c, axis_name="sp"),
+        mesh=mesh, in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None),
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        f(q, k, v)
