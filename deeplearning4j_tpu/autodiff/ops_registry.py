@@ -502,3 +502,288 @@ def _mhdpa2(q, k, v, wq, wk, wv, wo, n_heads, mask=None):
     B, H, T, D = o.shape
     o = o.transpose(0, 1, 3, 2).reshape(B, H * D, T)
     return jnp.einsum("oi,bit->bot", wo, o)
+
+
+# ------------------------------------------------------------ corpus wave 2
+# (r3: breadth toward the reference's ~500-op corpus — SURVEY §2.1 N6 groups:
+# transforms, reduce3 distances, shape/indexing, nn convs/pooling/resize,
+# losses, random, linalg, segment/scatter, bitwise, special functions. Every
+# op lands with a TestCase in tests/test_op_validation.py — the coverage
+# gate fails otherwise.)
+
+for _name, _fn in {
+    # transforms / activations
+    "rint": jnp.rint,
+    "trunc": jnp.trunc,
+    "fmod": jnp.fmod,
+    "log_sigmoid": jax.nn.log_sigmoid,
+    "prelu": lambda x, alpha: jnp.where(x > 0, x, alpha * x),
+    "thresholded_relu": lambda x, theta=1.0: jnp.where(x > theta, x, 0.0),
+    "rectified_tanh": lambda x: jnp.maximum(jnp.tanh(x), 0.0),
+    "hard_swish": lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0,
+    "log10": jnp.log10,
+    "erfinv": lambda x: jax.scipy.special.erfinv(x),
+    "lgamma": lambda x: jax.scipy.special.gammaln(x),
+    "digamma": lambda x: jax.scipy.special.digamma(x),
+    "polygamma": lambda n, x: jax.scipy.special.polygamma(n, x),
+    "igamma": lambda a, x: lax.igamma(a, x),
+    "igammac": lambda a, x: lax.igammac(a, x),
+    "betainc": lambda a, b, x: lax.betainc(a, b, x),
+    "swapaxes": jnp.swapaxes,
+    "l2_normalize": lambda x, axis=-1, eps=1e-12: x / jnp.sqrt(
+        jnp.maximum(jnp.sum(jnp.square(x), axis=axis, keepdims=True), eps)),
+    "clip_by_norm": lambda x, clip_norm: x * jnp.minimum(
+        1.0, clip_norm / jnp.maximum(jnp.sqrt(jnp.sum(jnp.square(x))), 1e-12)),
+    "standardize": lambda x, dims=-1: (x - jnp.mean(x, axis=dims, keepdims=True))
+        / jnp.maximum(jnp.std(x, axis=dims, keepdims=True), 1e-12),
+    # entropy family (nd4j Entropy/LogEntropy/ShannonEntropy reductions)
+    "entropy": lambda x, dims=None: -jnp.sum(x * jnp.log(x), axis=dims),
+    "log_entropy": lambda x, dims=None: jnp.log(-jnp.sum(x * jnp.log(x), axis=dims)),
+    "shannon_entropy": lambda x, dims=None: -jnp.sum(x * jnp.log2(x), axis=dims),
+    # reduce3 distances (nd4j reduce3 family)
+    "euclidean_distance": lambda a, b, dims=None: jnp.sqrt(
+        jnp.sum(jnp.square(a - b), axis=dims)),
+    "manhattan_distance": lambda a, b, dims=None: jnp.sum(jnp.abs(a - b), axis=dims),
+    "cosine_similarity": lambda a, b, axis=-1: jnp.sum(
+        (a / jnp.linalg.norm(a, axis=axis, keepdims=True))
+        * (b / jnp.linalg.norm(b, axis=axis, keepdims=True)), axis=axis),
+    "hamming_distance": lambda a, b: jnp.sum((a != b).astype(jnp.float32)),
+    "jaccard_distance": lambda a, b: 1.0 - jnp.sum(jnp.minimum(a, b))
+        / jnp.sum(jnp.maximum(a, b)),
+    # shape / indexing
+    "broadcast_to": lambda x, shape: jnp.broadcast_to(x, tuple(shape)),
+    "repeat": lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis),
+    "roll": lambda x, shift, axis=None: jnp.roll(x, shift, axis=axis),
+    "sort": lambda x, axis=-1, descending=False: (
+        -jnp.sort(-x, axis=axis) if descending else jnp.sort(x, axis=axis)),
+    "argsort": lambda x, axis=-1: jnp.argsort(x, axis=axis),
+    "triu": lambda x, k=0: jnp.triu(x, k),
+    "tril": lambda x, k=0: jnp.tril(x, k),
+    "fill": lambda shape, value: jnp.full(tuple(shape), value),
+    "zeros": lambda shape: jnp.zeros(tuple(shape)),
+    "ones": lambda shape: jnp.ones(tuple(shape)),
+    "full_like": lambda x, value: jnp.full_like(x, value),
+    "sequence_mask": lambda lengths, maxlen: (
+        jnp.arange(maxlen)[None, :] < jnp.asarray(lengths)[:, None]),
+    "reverse_sequence": lambda x, seq_lengths, seq_axis=1, batch_axis=0:
+        _reverse_sequence(x, seq_lengths, seq_axis, batch_axis),
+    "depth_to_space": lambda x, bs: lax.reshape(  # NCHW, exact inverse of
+        # space_to_depth's (c, bh, bw) channel packing
+        jnp.transpose(jnp.reshape(x, (x.shape[0], x.shape[1] // (bs * bs), bs, bs,
+                                      x.shape[2], x.shape[3])), (0, 1, 4, 2, 5, 3)),
+        (x.shape[0], x.shape[1] // (bs * bs), x.shape[2] * bs, x.shape[3] * bs)),
+    # comparison / predicates
+    "is_non_decreasing": lambda x: jnp.all(x.reshape(-1)[1:] >= x.reshape(-1)[:-1]),
+    "is_strictly_increasing": lambda x: jnp.all(x.reshape(-1)[1:] > x.reshape(-1)[:-1]),
+    # histogram-ish
+    # minlength=0 → numpy semantics (size from data; eager only — under jit
+    # the dynamic output shape raises jax's standard error, so graph use
+    # passes an explicit minlength)
+    "bincount": lambda x, minlength=0: jnp.bincount(
+        x, length=int(minlength) if minlength else None),
+    "confusion_matrix": lambda labels, preds, num_classes: jnp.zeros(
+        (int(num_classes), int(num_classes)), jnp.int32).at[labels, preds].add(1),
+    # bitwise (int inputs)
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "left_shift": jnp.left_shift,
+    "right_shift": jnp.right_shift,
+    "cyclic_shift_bits": lambda x, n, bits=32: jnp.bitwise_or(
+        jnp.left_shift(x, n), jnp.right_shift(x, bits - n)),
+    # linalg wave 2
+    "matrix_diag": lambda v: jnp.vectorize(jnp.diag, signature="(n)->(n,n)")(v),
+    "matrix_diag_part": lambda x: jnp.diagonal(x, axis1=-2, axis2=-1),
+    "matrix_band_part": lambda x, lower, upper: x * (
+        (jnp.arange(x.shape[-2])[:, None] - jnp.arange(x.shape[-1])[None, :]
+         <= (lower if lower >= 0 else x.shape[-2]))
+        & (jnp.arange(x.shape[-1])[None, :] - jnp.arange(x.shape[-2])[:, None]
+           <= (upper if upper >= 0 else x.shape[-1]))),
+    "cross": jnp.cross,
+    "slogdet": lambda a: jnp.linalg.slogdet(a),
+    "triangular_solve": lambda a, b, lower=True: jax.scipy.linalg.solve_triangular(
+        a, b, lower=lower),
+    "eigh": lambda a: jnp.linalg.eigh(a),
+    "lstsq": lambda a, b: jnp.linalg.lstsq(a, b)[0],
+    # segment wave 2
+    "segment_max": lambda x, ids, num_segments=None: jax.ops.segment_max(
+        x, ids, num_segments),
+    "segment_min": lambda x, ids, num_segments=None: jax.ops.segment_min(
+        x, ids, num_segments),
+    "segment_prod": lambda x, ids, num_segments=None: jax.ops.segment_prod(
+        x, ids, num_segments),
+    "segment_mean": lambda x, ids, num_segments=None: jax.ops.segment_sum(
+        x, ids, num_segments) / jnp.maximum(jax.ops.segment_sum(
+            jnp.ones_like(x), ids, num_segments), 1.0),
+    "unsorted_segment_sum": lambda x, ids, num_segments=None: jax.ops.segment_sum(
+        x, ids, num_segments),
+    # scatter wave 2
+    "scatter_sub": lambda ref, idx, upd: ref.at[idx].add(-upd),
+    "scatter_mul": lambda ref, idx, upd: ref.at[idx].mul(upd),
+    "scatter_div": lambda ref, idx, upd: ref.at[idx].divide(upd),
+    "scatter_min": lambda ref, idx, upd: ref.at[idx].min(upd),
+}.items():
+    OPS[_name] = _fn
+
+
+def _reverse_sequence(x, seq_lengths, seq_axis=1, batch_axis=0):
+    """Per-example prefix reversal, trace-safe (index algebra, no dynamic
+    slicing on traced lengths)."""
+    x = jnp.moveaxis(x, (batch_axis, seq_axis), (0, 1))
+    T = x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    lens = jnp.asarray(seq_lengths)[:, None]
+    rev = jnp.where(idx < lens, lens - 1 - idx, idx)          # [B, T]
+    gathered = jnp.take_along_axis(
+        x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)), axis=1)
+    return jnp.moveaxis(gathered, (0, 1), (batch_axis, seq_axis))
+
+
+@op("moments")
+def _moments(x, dims=None):
+    return jnp.mean(x, axis=dims), jnp.var(x, axis=dims)
+
+
+@op("top_k")
+def _top_k(x, k):
+    return lax.top_k(x, int(k))
+
+
+@op("in_top_k")
+def _in_top_k(targets, preds, k):
+    _, idx = lax.top_k(preds, int(k))
+    return jnp.any(idx == jnp.asarray(targets)[:, None], axis=-1)
+
+
+@op("conv1d")
+def _conv1d(x, w, b=None, stride=1, padding="SAME"):
+    # NCW / OIW (nd4j conv1d layout)
+    z = lax.conv_general_dilated(x, w, window_strides=(int(stride),), padding=padding,
+                                 dimension_numbers=("NCH", "OIH", "NCH"))
+    return z if b is None else z + b[None, :, None]
+
+
+@op("conv3d")
+def _conv3d(x, w, b=None, stride=(1, 1, 1), padding="SAME"):
+    # NCDHW / OIDHW
+    z = lax.conv_general_dilated(x, w, window_strides=tuple(stride), padding=padding,
+                                 dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return z if b is None else z + b[None, :, None, None, None]
+
+
+@op("depthwise_conv2d")
+def _depthwise_conv2d(x, w, stride=(1, 1), padding="SAME"):
+    """x NCHW, w [C*mul, 1, kH, kW] (grouped conv, feature_group_count=C)."""
+    C = x.shape[1]
+    return lax.conv_general_dilated(x, w, window_strides=tuple(stride), padding=padding,
+                                    dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                                    feature_group_count=C)
+
+
+@op("deconv2d")
+def _deconv2d(x, w, stride=(2, 2), padding="SAME"):
+    """Transpose conv, NCHW / IOHW kernel (nd4j deconv2d)."""
+    return lax.conv_transpose(x, w, strides=tuple(stride), padding=padding,
+                              dimension_numbers=("NCHW", "IOHW", "NCHW"))
+
+
+@op("upsampling2d")
+def _upsampling2d(x, scale=2):
+    return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+
+
+@op("max_pool3d")
+def _max_pool3d(x, kernel=(2, 2, 2), stride=(2, 2, 2), padding="VALID"):
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, 1) + tuple(kernel),
+                             (1, 1) + tuple(stride), padding)
+
+
+@op("avg_pool3d")
+def _avg_pool3d(x, kernel=(2, 2, 2), stride=(2, 2, 2), padding="VALID"):
+    s = lax.reduce_window(x, 0.0, lax.add, (1, 1) + tuple(kernel),
+                          (1, 1) + tuple(stride), padding)
+    c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, (1, 1) + tuple(kernel),
+                          (1, 1) + tuple(stride), padding)
+    return s / c
+
+
+@op("lrn")
+def _lrn(x, depth_radius=5, bias=1.0, alpha=1.0, beta=0.5):
+    """Local response normalization over channels (NCHW)."""
+    sq = jnp.square(x)
+    pad = int(depth_radius)
+    padded = jnp.pad(sq, [(0, 0), (pad, pad), (0, 0), (0, 0)])
+    win = sum(padded[:, i:i + x.shape[1]] for i in range(2 * pad + 1))
+    return x / jnp.power(bias + alpha * win, beta)
+
+
+@op("resize_bilinear")
+def _resize_bilinear(x, size):
+    """NCHW resize (nd4j resize_bilinear image op)."""
+    B, C, H, W = x.shape
+    return jax.image.resize(x, (B, C, int(size[0]), int(size[1])), "bilinear")
+
+
+@op("resize_nearest_neighbor")
+def _resize_nn(x, size):
+    B, C, H, W = x.shape
+    return jax.image.resize(x, (B, C, int(size[0]), int(size[1])), "nearest")
+
+
+@op("adjust_contrast")
+def _adjust_contrast(x, factor):
+    mean = jnp.mean(x, axis=(-2, -1), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+@op("hinge_loss")
+def _hinge(labels, preds):
+    return jnp.mean(jnp.maximum(0.0, 1.0 - labels * preds))
+
+
+@op("squared_hinge_loss")
+def _sq_hinge(labels, preds):
+    return jnp.mean(jnp.square(jnp.maximum(0.0, 1.0 - labels * preds)))
+
+
+@op("poisson_loss")
+def _poisson(labels, preds):
+    return jnp.mean(preds - labels * jnp.log(preds + 1e-12))
+
+
+@op("kl_divergence")
+def _kld(labels, preds, eps=1e-12):
+    return jnp.mean(jnp.sum(labels * (jnp.log(labels + eps) - jnp.log(preds + eps)),
+                            axis=-1))
+
+
+@op("weighted_cross_entropy_with_logits")
+def _wce(targets, logits, pos_weight):
+    log_w = (1.0 + (pos_weight - 1.0) * targets)
+    return jnp.mean((1.0 - targets) * logits + log_w * (
+        jnp.log1p(jnp.exp(-jnp.abs(logits))) + jnp.maximum(-logits, 0.0)))
+
+
+@op("absolute_difference")
+def _absdiff(labels, preds):
+    return jnp.mean(jnp.abs(labels - preds))
+
+
+@op("random_exponential")
+def _rexp(rng, shape, lam=1.0):
+    return jax.random.exponential(rng, shape) / lam
+
+
+@op("random_gamma")
+def _rgamma(rng, shape, alpha=1.0):
+    return jax.random.gamma(rng, alpha, shape)
+
+
+@op("random_poisson")
+def _rpoisson(rng, shape, lam=1.0):
+    return jax.random.poisson(rng, lam, shape).astype(jnp.float32)
+
+
+@op("random_shuffle")
+def _rshuffle(rng, x):
+    return jax.random.permutation(rng, x, axis=0)

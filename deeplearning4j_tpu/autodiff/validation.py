@@ -112,8 +112,9 @@ def check_op_gradients(op_name: str, args, kwargs=None, diff_args: Sequence[int]
 
     fn = OPS[op_name]
     kwargs = kwargs or {}
-    jargs = [jnp.asarray(a) if isinstance(a, (np.ndarray, float, int)) else a
-             for a in args]
+    # only real arrays become traced values; python ints/floats stay static
+    # (axis numbers, scale factors — jnp.swapaxes etc. require hashables)
+    jargs = [jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args]
 
     def loss(*diff_vals):
         full = list(jargs)

@@ -62,6 +62,10 @@ def _topk_dispatch(gates, k: int, capacity: int):
     Slot-major priority: all tokens' 1st choices claim capacity before any
     2nd choice (GShard's policy), positions via cumsum — pure dense algebra.
     """
+    # routing algebra in fp32 regardless of activation dtype: a bf16 cumsum
+    # cannot represent slot positions > 256 and silently collides capacity
+    # slots (tokens summed into the wrong expert input)
+    gates = gates.astype(jnp.float32)
     N, E = gates.shape
     topv, topi = jax.lax.top_k(gates, k)                      # [N, k]
     topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)

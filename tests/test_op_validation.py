@@ -321,6 +321,181 @@ CASES = {
                          lambda out, args: 0.3 < float(np.mean(np.asarray(out))) < 0.7, ()),
 }
 
+# ---------------------------------------------------------- corpus wave 2
+INT_A = np.array([[5, 3], [12, 7]], np.int32)
+INT_B = np.array([[3, 1], [6, 2]], np.int32)
+SEG_X = np.arange(6.0, dtype=np.float32) + 1
+SEG_ID = np.array([0, 0, 1, 1, 2, 2], np.int32)
+PROB = (POS / POS.sum(-1, keepdims=True)).astype(np.float32)  # rows sum to 1
+IMG5 = R.randn(1, 2, 4, 4, 4).astype(np.float32)              # NCDHW
+K1 = (R.randn(3, 3, 3) * 0.3).astype(np.float32)              # OIW
+K3 = (R.randn(2, 2, 2, 2, 2) * 0.3).astype(np.float32)        # OIDHW
+KDW = (R.randn(3, 1, 3, 3) * 0.3).astype(np.float32)          # depthwise C=3
+KTR = (R.randn(3, 2, 2, 2) * 0.3).astype(np.float32)          # IOHW deconv
+
+def _np_lrn(x, dr=2, bias=1.0, alpha=1.0, beta=0.5):
+    out = np.zeros_like(x)
+    C = x.shape[1]
+    for c in range(C):
+        lo, hi = max(0, c - dr), min(C, c + dr + 1)
+        s = (x[:, lo:hi] ** 2).sum(1)
+        out[:, c] = x[:, c] / (bias + alpha * s) ** beta
+    return out
+
+CASES.update({
+    "rint": ((A,), {}, np.rint(A), ()),
+    "trunc": ((A,), {}, np.trunc(A), ()),
+    "fmod": ((A, POS), {}, np.fmod(A, POS), ()),
+    "log_sigmoid": ((A,), {}, -np.log1p(np.exp(-A)), (0,)),
+    "prelu": ((OFF0, np.float32(0.2)), {}, np.where(OFF0 > 0, OFF0, 0.2 * OFF0), (0,)),
+    "thresholded_relu": ((OFF0,), {}, np.where(OFF0 > 1.0, OFF0, 0.0), ()),
+    "rectified_tanh": ((OFF0,), {}, np.maximum(np.tanh(OFF0), 0), ()),
+    "hard_swish": ((OFF0,), {}, OFF0 * np.clip(OFF0 + 3, 0, 6) / 6, (0,)),
+    "log10": ((POS,), {}, np.log10(POS), (0,)),
+    "erfinv": ((UNIT,), {}, None, (0,)),
+    "lgamma": ((POS + 1,), {}, None, (0,)),
+    "digamma": ((POS + 1,), {}, None, (0,)),
+    "polygamma": ((1, POS + 1), {}, None, ()),
+    "igamma": ((POS + 1, POS), {}, None, ()),
+    "igammac": ((POS + 1, POS), {}, None, ()),
+    "betainc": ((POS + 1, POS + 1, PROB), {}, None, ()),
+    "swapaxes": ((A, 0, 1), {}, A.T, (0,)),
+    "l2_normalize": ((A,), {}, A / np.linalg.norm(A, axis=-1, keepdims=True), (0,)),
+    "clip_by_norm": ((A, 1.0), {}, A / max(np.linalg.norm(A), 1.0), (0,)),
+    "standardize": ((A,), {},
+                    (A - A.mean(-1, keepdims=True)) / A.std(-1, keepdims=True), (0,)),
+    "entropy": ((PROB,), dict(dims=1), -(PROB * np.log(PROB)).sum(1), (0,)),
+    "log_entropy": ((PROB,), dict(dims=1),
+                    np.log(-(PROB * np.log(PROB)).sum(1)), ()),
+    "shannon_entropy": ((PROB,), dict(dims=1), -(PROB * np.log2(PROB)).sum(1), ()),
+    "euclidean_distance": ((A, B), dict(dims=1),
+                           np.sqrt(((A - B) ** 2).sum(1)), (0, 1)),
+    "manhattan_distance": ((A, B), dict(dims=1), np.abs(A - B).sum(1), ()),
+    "cosine_similarity": ((A, B), {},
+                          (A * B).sum(-1) / (np.linalg.norm(A, axis=-1)
+                                             * np.linalg.norm(B, axis=-1)), (0, 1)),
+    "hamming_distance": ((INT_A, INT_B), {},
+                         np.sum(INT_A != INT_B).astype(np.float32), ()),
+    "jaccard_distance": ((POS, POS.T.reshape(3, 4)), {},
+                         1 - np.minimum(POS, POS.T.reshape(3, 4)).sum()
+                         / np.maximum(POS, POS.T.reshape(3, 4)).sum(), ()),
+    "broadcast_to": ((A[0], (3, 4)), {}, np.broadcast_to(A[0], (3, 4)), ()),
+    "repeat": ((A, 2), dict(axis=1), np.repeat(A, 2, axis=1), (0,)),
+    "roll": ((A, 1), dict(axis=0), np.roll(A, 1, axis=0), (0,)),
+    "sort": ((A,), {}, np.sort(A, axis=-1), (0,)),
+    "argsort": ((A,), {}, np.argsort(A, axis=-1), ()),
+    "triu": ((SQ,), {}, np.triu(SQ), (0,)),
+    "tril": ((SQ,), {}, np.tril(SQ), (0,)),
+    "fill": (((2, 3), 7.0), {}, np.full((2, 3), 7.0), ()),
+    "zeros": (((2, 2),), {}, np.zeros((2, 2)), ()),
+    "ones": (((2, 2),), {}, np.ones((2, 2)), ()),
+    "full_like": ((A, 5.0), {}, np.full_like(A, 5.0), ()),
+    "sequence_mask": ((np.array([1, 3], np.int32), 4), {},
+                      np.array([[1, 0, 0, 0], [1, 1, 1, 0]], bool), ()),
+    "reverse_sequence": ((A, np.array([2, 3, 1], np.int32)), {},
+                         np.stack([np.concatenate([A[0][:2][::-1], A[0][2:]]),
+                                   np.concatenate([A[1][:3][::-1], A[1][3:]]),
+                                   A[2]]), ()),
+    "depth_to_space": ((R.randn(1, 8, 2, 2).astype(np.float32), 2), {},
+                       lambda out, args: np.testing.assert_allclose(
+                           np.asarray(OPS["space_to_depth"](out, 2)), args[0],
+                           rtol=1e-6), ()),
+    "is_non_decreasing": ((np.array([1.0, 2.0, 2.0]),), {}, True, ()),
+    "is_strictly_increasing": ((np.array([1.0, 2.0, 2.0]),), {}, False, ()),
+    "bincount": ((np.array([0, 1, 1, 3], np.int32),), dict(minlength=5),
+                 np.array([1, 2, 0, 1, 0]), ()),
+    "confusion_matrix": ((np.array([0, 1, 1], np.int32),
+                          np.array([0, 1, 0], np.int32), 2), {},
+                         np.array([[1, 0], [1, 1]]), ()),
+    "bitwise_and": ((INT_A, INT_B), {}, INT_A & INT_B, ()),
+    "bitwise_or": ((INT_A, INT_B), {}, INT_A | INT_B, ()),
+    "bitwise_xor": ((INT_A, INT_B), {}, INT_A ^ INT_B, ()),
+    "left_shift": ((INT_A, np.int32(1)), {}, INT_A << 1, ()),
+    "right_shift": ((INT_A, np.int32(1)), {}, INT_A >> 1, ()),
+    "cyclic_shift_bits": ((INT_A.astype(np.uint32), np.uint32(4)), {},
+                          (INT_A.astype(np.uint32) << np.uint32(4))
+                          | (INT_A.astype(np.uint32) >> np.uint32(28)), ()),
+    "matrix_diag": ((A,), {}, np.stack([np.diag(r) for r in A]), ()),
+    "matrix_diag_part": ((np.stack([SQ, SQ]),), {},
+                         np.stack([np.diag(SQ)] * 2), ()),
+    "matrix_band_part": ((SQ, 0, -1), {}, np.triu(SQ), ()),
+    "cross": ((A[:, :3], B[:, :3]), {}, np.cross(A[:, :3], B[:, :3]), (0, 1)),
+    "slogdet": ((SPD,), {},
+                lambda out, args: np.testing.assert_allclose(
+                    float(out[0]) * np.exp(float(out[1])), np.linalg.det(SPD),
+                    rtol=1e-4), ()),
+    "triangular_solve": ((np.tril(SPD), A[:, :2].copy()), {},
+                         np.linalg.solve(np.tril(SPD), A[:, :2]), ()),
+    "eigh": ((SPD,), {},
+             lambda out, args: np.testing.assert_allclose(
+                 np.asarray(out[1]) @ np.diag(np.asarray(out[0]))
+                 @ np.asarray(out[1]).T, SPD, atol=1e-3), ()),
+    "lstsq": ((SPD, A[:, :2].copy()), {},
+              np.linalg.lstsq(SPD, A[:, :2], rcond=None)[0], ()),
+    "segment_max": ((SEG_X, SEG_ID), dict(num_segments=3),
+                    np.array([2.0, 4.0, 6.0]), ()),
+    "segment_min": ((SEG_X, SEG_ID), dict(num_segments=3),
+                    np.array([1.0, 3.0, 5.0]), ()),
+    "segment_prod": ((SEG_X, SEG_ID), dict(num_segments=3),
+                     np.array([2.0, 12.0, 30.0]), ()),
+    "segment_mean": ((SEG_X, SEG_ID), dict(num_segments=3),
+                     np.array([1.5, 3.5, 5.5]), ()),
+    "unsorted_segment_sum": ((SEG_X, SEG_ID), dict(num_segments=3),
+                             np.array([3.0, 7.0, 11.0]), ()),
+    "scatter_sub": ((jnp.full((4, 2), 5.0), IDX, np.ones((3, 2), np.float32)),
+                    {}, np.array([[4.0, 4], [4, 4], [4, 4], [5, 5]]), ()),
+    "scatter_mul": ((jnp.full((4, 2), 5.0), IDX, np.full((3, 2), 2.0, np.float32)),
+                    {}, np.array([[10.0, 10], [10, 10], [10, 10], [5, 5]]), ()),
+    "scatter_div": ((jnp.full((4, 2), 6.0), IDX, np.full((3, 2), 2.0, np.float32)),
+                    {}, np.array([[3.0, 3], [3, 3], [3, 3], [6, 6]]), ()),
+    "scatter_min": ((jnp.full((4, 2), 0.5), IDX, np.zeros((3, 2), np.float32)),
+                    {}, np.array([[0.0, 0], [0, 0], [0, 0], [0.5, 0.5]]), ()),
+    "moments": ((A,), dict(dims=1),
+                lambda out, args: (np.testing.assert_allclose(
+                    np.asarray(out[0]), A.mean(1), rtol=1e-5, atol=1e-6),
+                    np.testing.assert_allclose(
+                        np.asarray(out[1]), A.var(1), rtol=1e-5, atol=1e-6)), (0,)),
+    "top_k": ((A, 2), {},
+              lambda out, args: np.testing.assert_allclose(
+                  np.asarray(out[0]), np.sort(A, -1)[:, ::-1][:, :2],
+                  rtol=1e-6), ()),
+    "in_top_k": ((IDX, A, 2), {},
+                 lambda out, args: np.asarray(out).shape == (3,), ()),
+    "conv1d": ((IMG[:, :, :, 0].copy(), K1), {}, None, (0, 1)),
+    "conv3d": ((IMG5, K3), {}, None, (0, 1)),
+    "depthwise_conv2d": ((IMG, KDW), {}, None, (0, 1)),
+    "deconv2d": ((IMG[:, :3][:, :3].copy(), KTR), {}, None, (0,)),
+    "upsampling2d": ((IMG, 2), {}, np.repeat(np.repeat(IMG, 2, 2), 2, 3), (0,)),
+    "max_pool3d": ((IMG5,), {}, IMG5.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7)), (0,)),
+    "avg_pool3d": ((IMG5,), {}, IMG5.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7)), (0,)),
+    "lrn": ((IMG,), dict(depth_radius=2), _np_lrn(IMG, 2), (0,)),
+    "resize_bilinear": ((IMG, (12, 12)), {}, None, (0,)),
+    "resize_nearest_neighbor": ((IMG, (12, 12)), {},
+                                np.repeat(np.repeat(IMG, 2, 2), 2, 3), ()),
+    "adjust_contrast": ((IMG, 2.0), {},
+                        (IMG - IMG.mean((-2, -1), keepdims=True)) * 2
+                        + IMG.mean((-2, -1), keepdims=True), (0,)),
+    "hinge_loss": ((np.sign(A), B), {},
+                   np.mean(np.maximum(0, 1 - np.sign(A) * B)), (1,)),
+    "squared_hinge_loss": ((np.sign(A), B), {},
+                           np.mean(np.maximum(0, 1 - np.sign(A) * B) ** 2), (1,)),
+    "poisson_loss": ((POS, POS + 0.3), {},
+                     np.mean((POS + 0.3) - POS * np.log(POS + 0.3 + 1e-12)), (1,)),
+    "kl_divergence": ((PROB, np.roll(PROB, 1, 0)), {}, None, (1,)),
+    "weighted_cross_entropy_with_logits": (((A > 0).astype(np.float32), B, 2.0),
+                                           {}, None, (1,)),
+    "absolute_difference": ((A, B), {}, np.abs(A - B).mean(), (1,)),
+    "random_exponential": ((jax.random.key(0), (500,)), {},
+                           lambda out, args: float(np.min(np.asarray(out))) >= 0, ()),
+    "random_gamma": ((jax.random.key(0), (500,)), {},
+                     lambda out, args: float(np.min(np.asarray(out))) >= 0, ()),
+    "random_poisson": ((jax.random.key(0), (500,)), dict(lam=3.0),
+                       lambda out, args: 2.0 < float(np.mean(np.asarray(out))) < 4.0, ()),
+    "random_shuffle": ((jax.random.key(0), A), {},
+                       lambda out, args: np.testing.assert_allclose(
+                           np.sort(np.asarray(out), 0), np.sort(A, 0)), ()),
+})
+
 
 @pytest.mark.parametrize("name", sorted(OPS))
 def test_op_forward(name):
