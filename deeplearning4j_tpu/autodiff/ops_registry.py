@@ -15,6 +15,7 @@ from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 OPS: Dict[str, Callable] = {}
@@ -536,10 +537,13 @@ for _name, _fn in {
         1.0, clip_norm / jnp.maximum(jnp.sqrt(jnp.sum(jnp.square(x))), 1e-12)),
     "standardize": lambda x, dims=-1: (x - jnp.mean(x, axis=dims, keepdims=True))
         / jnp.maximum(jnp.std(x, axis=dims, keepdims=True), 1e-12),
-    # entropy family (nd4j Entropy/LogEntropy/ShannonEntropy reductions)
-    "entropy": lambda x, dims=None: -jnp.sum(x * jnp.log(x), axis=dims),
-    "log_entropy": lambda x, dims=None: jnp.log(-jnp.sum(x * jnp.log(x), axis=dims)),
-    "shannon_entropy": lambda x, dims=None: -jnp.sum(x * jnp.log2(x), axis=dims),
+    # entropy family (nd4j Entropy/LogEntropy/ShannonEntropy reductions);
+    # 0*log(0) takes its limit 0 (one-hot/sparse distributions are normal
+    # inputs here)
+    "entropy": lambda x, dims=None: -jnp.sum(_xlogx(x, jnp.log), axis=dims),
+    "log_entropy": lambda x, dims=None: jnp.log(
+        -jnp.sum(_xlogx(x, jnp.log), axis=dims)),
+    "shannon_entropy": lambda x, dims=None: -jnp.sum(_xlogx(x, jnp.log2), axis=dims),
     # reduce3 distances (nd4j reduce3 family)
     "euclidean_distance": lambda a, b, dims=None: jnp.sqrt(
         jnp.sum(jnp.square(a - b), axis=dims)),
@@ -576,11 +580,7 @@ for _name, _fn in {
     "is_non_decreasing": lambda x: jnp.all(x.reshape(-1)[1:] >= x.reshape(-1)[:-1]),
     "is_strictly_increasing": lambda x: jnp.all(x.reshape(-1)[1:] > x.reshape(-1)[:-1]),
     # histogram-ish
-    # minlength=0 → numpy semantics (size from data; eager only — under jit
-    # the dynamic output shape raises jax's standard error, so graph use
-    # passes an explicit minlength)
-    "bincount": lambda x, minlength=0: jnp.bincount(
-        x, length=int(minlength) if minlength else None),
+    "bincount": lambda x, minlength=0: _bincount(x, minlength),
     "confusion_matrix": lambda labels, preds, num_classes: jnp.zeros(
         (int(num_classes), int(num_classes)), jnp.int32).at[labels, preds].add(1),
     # bitwise (int inputs)
@@ -589,8 +589,7 @@ for _name, _fn in {
     "bitwise_xor": jnp.bitwise_xor,
     "left_shift": jnp.left_shift,
     "right_shift": jnp.right_shift,
-    "cyclic_shift_bits": lambda x, n, bits=32: jnp.bitwise_or(
-        jnp.left_shift(x, n), jnp.right_shift(x, bits - n)),
+    "cyclic_shift_bits": lambda x, n, bits=32: _cyclic_shift_bits(x, n, bits),
     # linalg wave 2
     "matrix_diag": lambda v: jnp.vectorize(jnp.diag, signature="(n)->(n,n)")(v),
     "matrix_diag_part": lambda x: jnp.diagonal(x, axis1=-2, axis2=-1),
@@ -637,6 +636,38 @@ def _reverse_sequence(x, seq_lengths, seq_axis=1, batch_axis=0):
     gathered = jnp.take_along_axis(
         x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)), axis=1)
     return jnp.moveaxis(gathered, (0, 1), (batch_axis, seq_axis))
+
+
+def _xlogx(x, log_fn):
+    return jnp.where(x > 0, x * log_fn(jnp.maximum(x, 1e-38)), 0.0)
+
+
+def _bincount(x, minlength=0):
+    """numpy semantics when x is concrete: output length covers the data max
+    (jnp.bincount's length= TRUNCATES, silently dropping high values). Under
+    tracing the output shape must be static → minlength is the fixed length
+    and is required."""
+    import jax.core as _core
+
+    if not isinstance(x, _core.Tracer):
+        xn = np.asarray(x)
+        data_max = int(xn.max()) + 1 if xn.size else 0
+        return jnp.bincount(jnp.asarray(x), length=max(int(minlength), data_max))
+    if not minlength:
+        raise ValueError("bincount under jit needs an explicit minlength "
+                         "(static output shape)")
+    return jnp.bincount(x, length=int(minlength))
+
+
+def _cyclic_shift_bits(x, n, bits=32):
+    """Bit rotation on the UNSIGNED pattern (nd4j cyclic_shift_bits):
+    arithmetic right shift on signed ints would sign-fill, and a shift by
+    the full bit width is undefined — both avoided here."""
+    udt = {32: jnp.uint32, 64: jnp.uint64, 16: jnp.uint16, 8: jnp.uint8}[bits]
+    ux = x.astype(udt) if hasattr(x, "astype") else jnp.asarray(x, udt)
+    n = jnp.asarray(n, udt) % udt(bits)
+    rot = jnp.left_shift(ux, n) | jnp.right_shift(ux, (udt(bits) - n) % udt(bits))
+    return rot.astype(x.dtype) if hasattr(x, "dtype") else rot
 
 
 @op("moments")

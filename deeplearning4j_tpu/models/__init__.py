@@ -21,8 +21,12 @@ from .zoo import LeNet, SimpleCNN, ZooModel
 from .resnet import ResNet50
 from .vgg import VGG16
 from .text_lstm import TextGenerationLSTM
+from .zoo_ext import AlexNet, Darknet19, SqueezeNet, UNet, Xception
+from .moe import MoEConfig, init_moe_params, moe_ffn, moe_partition_specs
 
 __all__ = [
+    "AlexNet", "Darknet19", "SqueezeNet", "UNet", "Xception",
+    "MoEConfig", "init_moe_params", "moe_ffn", "moe_partition_specs",
     "TransformerConfig",
     "transformer_forward",
     "transformer_init",

@@ -1,0 +1,205 @@
+"""GloVe embeddings (SURVEY §2.5 P5).
+
+Reference: ``org.deeplearning4j.models.glove.Glove`` — cooccurrence counting
+(``AbstractCoOccurrences``, window-weighted 1/distance) + AdaGrad weighted
+least squares on ``w_i·w~_j + b_i + b~_j - log X_ij``.
+
+TPU-native shape mirrors the rebuilt Word2Vec: cooccurrence extraction is
+vectorized numpy (bincount over fused pair codes — no python pair loops),
+and a WHOLE training epoch over the nonzero entries is one ``lax.scan``
+executable with donated tables + AdaGrad state (same latency analysis as
+``word2vec._w2v_epoch``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tokenization import DefaultTokenizerFactory
+from .vocab import VocabCache, VocabConstructor
+
+
+def _glove_update(tables, wi, wj, logx, fweight, lr):
+    """One batched AdaGrad step on the GloVe objective; duplicate rows
+    mean-aggregated (same rationale as word2vec._mean_scatter)."""
+    from .word2vec import _mean_scatter
+
+    w, wc, b, bc, gw, gwc, gb, gbc = tables
+    vi, vj = w[wi], wc[wj]                      # [B, D]
+    bi, bj = b[wi, 0], bc[wj, 0]                # [B] (bias tables are [V, 1])
+    diff = jnp.sum(vi * vj, axis=-1) + bi + bj - logx
+    g = fweight * diff                          # [B]
+
+    dvi = g[:, None] * vj
+    dvj = g[:, None] * vi
+    dbi = g
+    dbj = g
+
+    # AdaGrad: accumulate squared grads per row, scale updates
+    gw = _mean_scatter(gw, [(wi, jnp.square(dvi), None)])
+    gwc = _mean_scatter(gwc, [(wj, jnp.square(dvj), None)])
+    gb = _mean_scatter(gb, [(wi, jnp.square(dbi)[:, None], None)])
+    gbc = _mean_scatter(gbc, [(wj, jnp.square(dbj)[:, None], None)])
+    w = _mean_scatter(w, [(wi, -lr * dvi / jnp.sqrt(gw[wi] + 1e-8), None)])
+    wc = _mean_scatter(wc, [(wj, -lr * dvj / jnp.sqrt(gwc[wj] + 1e-8), None)])
+    b = _mean_scatter(b, [(wi, (-lr * dbi)[:, None] / jnp.sqrt(gb[wi] + 1e-8), None)])
+    bc = _mean_scatter(bc, [(wj, (-lr * dbj)[:, None] / jnp.sqrt(gbc[wj] + 1e-8), None)])
+    loss = 0.5 * jnp.mean(fweight * jnp.square(diff))
+    return (w, wc, b, bc, gw, gwc, gb, gbc), loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _glove_epoch(tables, wi_s, wj_s, logx_s, fw_s, lr):
+    def body(tabs, seg):
+        wi, wj, lx, fw = seg
+        return _glove_update(tabs, wi, wj, lx, fw, lr)
+
+    tables, losses = jax.lax.scan(body, tables, (wi_s, wj_s, logx_s, fw_s))
+    return tables, losses
+
+
+class Glove:
+    """org.deeplearning4j.models.glove.Glove parity surface."""
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 min_word_frequency: int = 1, learning_rate: float = 0.05,
+                 epochs: int = 5, batch_size: int = 4096, x_max: float = 100.0,
+                 alpha: float = 0.75, seed: int = 42, tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.x_max = x_max
+        self.alpha = alpha
+        self.seed = seed
+        self.tok = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._iter = None
+
+        def layer_size(self, n):
+            self._kw["layer_size"] = n; return self  # noqa: E702
+
+        def window_size(self, n):
+            self._kw["window"] = n; return self  # noqa: E702
+
+        def min_word_frequency(self, n):
+            self._kw["min_word_frequency"] = n; return self  # noqa: E702
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = lr; return self  # noqa: E702
+
+        def epochs(self, n):
+            self._kw["epochs"] = n; return self  # noqa: E702
+
+        def x_max(self, v):
+            self._kw["x_max"] = v; return self  # noqa: E702
+
+        def seed(self, s):
+            self._kw["seed"] = s; return self  # noqa: E702
+
+        def iterate(self, sentences):
+            self._iter = sentences; return self  # noqa: E702
+
+        def build(self) -> "Glove":
+            g = Glove(**self._kw)
+            g._sentences = self._iter
+            return g
+
+    # -------------------------------------------------------- cooccurrence
+
+    def _cooccurrences(self, sentences, rs):
+        """Window-weighted counts as COO arrays — bincount over fused i*V+j
+        codes (AbstractCoOccurrences, vectorized)."""
+        from .word2vec import Word2Vec
+
+        w2v_helper = Word2Vec.__new__(Word2Vec)
+        w2v_helper.vocab = self.vocab
+        w2v_helper.tok = self.tok
+        w2v_helper.subsampling = 0.0
+        flat, sent_id = w2v_helper._corpus_arrays(sentences, rs)
+        V = self.vocab.num_words()
+        if V * V > (1 << 27):
+            raise ValueError(
+                f"vocab {V}: dense cooccurrence code space V^2 exceeds the "
+                "bincount budget — raise min_word_frequency")
+        acc = np.zeros(V * V, np.float64)
+        for off in range(1, self.window + 1):
+            same = sent_id[:-off] == sent_id[off:]
+            a, bb = flat[:-off][same], flat[off:][same]
+            wgt = 1.0 / off
+            np.add.at(acc, a * V + bb, wgt)
+            np.add.at(acc, bb * V + a, wgt)
+        nz = np.nonzero(acc)[0]
+        return (nz // V).astype(np.int32), (nz % V).astype(np.int32), acc[nz]
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(self, sentences: Optional[Iterable[str]] = None) -> "Glove":
+        sentences = list(sentences if sentences is not None
+                         else getattr(self, "_sentences", None) or [])
+        if not sentences:
+            raise ValueError("no corpus")
+        rs = np.random.RandomState(self.seed)
+        self.vocab = VocabConstructor(self.tok, self.min_word_frequency).build_vocab(sentences)
+        V, D = self.vocab.num_words(), self.layer_size
+        wi, wj, x = self._cooccurrences(sentences, rs)
+        logx = np.log(x).astype(np.float32)
+        fw = np.minimum((x / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+
+        def t(shape):
+            return jnp.asarray((rs.rand(*shape).astype(np.float32) - 0.5) / D)
+
+        tables = (t((V, D)), t((V, D)),
+                  jnp.zeros((V, 1), jnp.float32), jnp.zeros((V, 1), jnp.float32),
+                  jnp.full((V, D), 1e-8, jnp.float32), jnp.full((V, D), 1e-8, jnp.float32),
+                  jnp.full((V, 1), 1e-8, jnp.float32), jnp.full((V, 1), 1e-8, jnp.float32))
+        # bias rows are [V,1] so _mean_scatter's [B,D] contract holds
+        n = len(wi)
+        B = min(self.batch_size, max(n, 1))
+        self.loss_curve: List[float] = []
+        for _ in range(self.epochs):
+            perm = rs.permutation(n)
+            pad = (-n) % B
+            idx = np.concatenate([perm, perm[:pad]]) if pad else perm
+            S = len(idx) // B
+            seg = lambda a: jnp.asarray(a[idx].reshape(S, B))  # noqa: E731
+            tables, losses = _glove_epoch(
+                tables, seg(wi), seg(wj), seg(logx), seg(fw),
+                jnp.float32(self.learning_rate))
+            self.loss_curve.append(float(jnp.mean(losses)))
+        # final embedding = w + w~ (GloVe paper §4.2)
+        self.syn0 = np.asarray(tables[0]) + np.asarray(tables[1])
+        return self
+
+    # -------------------------------------------------------------- queries
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self.syn0[i]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return float(np.dot(va, vb) / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.get_word_vector(word)
+        if v is None:
+            return []
+        norms = self.syn0 / (np.linalg.norm(self.syn0, axis=1, keepdims=True) + 1e-12)
+        sims = norms @ (v / (np.linalg.norm(v) + 1e-12))
+        return [self.vocab.word_at_index(int(i)) for i in np.argsort(-sims)
+                if self.vocab.word_at_index(int(i)) != word][:n]

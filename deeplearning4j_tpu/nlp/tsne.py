@@ -1,0 +1,148 @@
+"""t-SNE embedding (SURVEY §2.5 P5).
+
+Reference: ``org.deeplearning4j.plot.BarnesHutTsne`` (quad-tree O(N log N)
+host implementation). TPU inversion: the exact O(N²) formulation IS the
+TPU-native choice for the N ≤ ~20k regime the reference's tool targets —
+the pairwise matrices are dense matmul/softmax algebra that the MXU eats,
+and the whole gradient-descent loop (momentum + gain adaptation, early
+exaggeration) compiles into ONE ``lax.scan`` executable. The Barnes-Hut
+tree would be a pointer-chasing host program — exactly what not to build
+on an accelerator.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pairwise_sq_dists(x):
+    s = jnp.sum(jnp.square(x), axis=1)
+    d = s[:, None] - 2.0 * (x @ x.T) + s[None, :]
+    return jnp.maximum(d, 0.0)
+
+
+def _cond_probs(dists, perplexity: float, tol: float = 1e-5, iters: int = 50):
+    """Per-point binary search for the beta matching the target perplexity
+    (BarnesHutTsne.computeGaussianPerplexity) — vectorized over points,
+    lax.fori_loop over bisection steps."""
+    N = dists.shape[0]
+    log_u = jnp.log(perplexity)
+    eye = jnp.eye(N, dtype=bool)
+
+    def entropy_and_p(beta):
+        p = jnp.exp(-dists * beta[:, None])
+        p = jnp.where(eye, 0.0, p)
+        sum_p = jnp.maximum(jnp.sum(p, axis=1), 1e-12)
+        h = jnp.log(sum_p) + beta * jnp.sum(dists * p, axis=1) / sum_p
+        return h, p / sum_p[:, None]
+
+    def body(i, carry):
+        beta, lo, hi = carry
+        h, _ = entropy_and_p(beta)
+        too_high = h > log_u   # entropy too high → beta up
+        lo = jnp.where(too_high, beta, lo)
+        hi = jnp.where(too_high, hi, beta)
+        beta = jnp.where(jnp.isinf(hi), beta * 2.0,
+                         jnp.where(jnp.isinf(lo), beta / 2.0, (lo + hi) / 2.0))
+        return beta, lo, hi
+
+    beta0 = jnp.ones(N)
+    lo0 = jnp.full(N, -jnp.inf)
+    hi0 = jnp.full(N, jnp.inf)
+    beta, _, _ = jax.lax.fori_loop(0, iters, body, (beta0, lo0, hi0))
+    _, p = entropy_and_p(beta)
+    return p
+
+
+@functools.partial(jax.jit, static_argnames=("n_iter", "exaggeration_iters"))
+def _tsne_run(p_sym, y0, *, n_iter: int, learning_rate: float,
+              momentum_final: float, exaggeration: float,
+              exaggeration_iters: int):
+    N = p_sym.shape[0]
+    eye = jnp.eye(N, dtype=bool)
+
+    def step(carry, i):
+        y, vel, gains = carry
+        num = 1.0 / (1.0 + _pairwise_sq_dists(y))
+        num = jnp.where(eye, 0.0, num)
+        q = jnp.maximum(num / jnp.sum(num), 1e-12)
+        pp = jnp.where(i < exaggeration_iters, p_sym * exaggeration, p_sym)
+        pq = (pp - q) * num                                   # [N, N]
+        grad = 4.0 * (jnp.diag(jnp.sum(pq, axis=1)) - pq) @ y
+        momentum = jnp.where(i < 250, 0.5, momentum_final)
+        same_sign = jnp.sign(grad) == jnp.sign(vel)
+        gains = jnp.clip(jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01)
+        vel = momentum * vel - learning_rate * gains * grad
+        y = y + vel
+        y = y - jnp.mean(y, axis=0, keepdims=True)
+        kl = jnp.sum(pp * jnp.log(jnp.maximum(pp, 1e-12) / q))
+        return (y, vel, gains), kl
+
+    (y, _, _), kls = jax.lax.scan(
+        step, (y0, jnp.zeros_like(y0), jnp.ones_like(y0)), jnp.arange(n_iter))
+    return y, kls
+
+
+class BarnesHutTsne:
+    """Reference-parity surface (name kept; the implementation is exact/dense
+    by design — see module docstring)."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 learning_rate: float = 200.0, n_iter: int = 500,
+                 momentum: float = 0.8, exaggeration: float = 12.0,
+                 seed: int = 42):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.momentum = momentum
+        self.exaggeration = exaggeration
+        self.seed = seed
+        self.embedding_: Optional[np.ndarray] = None
+        self.kl_curve_: Optional[np.ndarray] = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def set_max_iter(self, n):
+            self._kw["n_iter"] = n; return self  # noqa: E702
+
+        def perplexity(self, p):
+            self._kw["perplexity"] = p; return self  # noqa: E702
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = lr; return self  # noqa: E702
+
+        def theta(self, t):
+            return self  # Barnes-Hut approximation knob: N/A (exact impl)
+
+        def seed(self, s):
+            self._kw["seed"] = s; return self  # noqa: E702
+
+        def build(self) -> "BarnesHutTsne":
+            return BarnesHutTsne(**self._kw)
+
+    def fit_transform(self, x) -> np.ndarray:
+        x = jnp.asarray(np.asarray(x, np.float32))
+        n = x.shape[0]
+        perp = min(self.perplexity, max((n - 1) / 3.0, 2.0))
+        d = _pairwise_sq_dists(x)
+        p = _cond_probs(d, perp)
+        p_sym = (p + p.T) / (2.0 * n)
+        y0 = jax.random.normal(jax.random.key(self.seed),
+                               (n, self.n_components)) * 1e-4
+        y, kls = _tsne_run(
+            p_sym, y0, n_iter=self.n_iter, learning_rate=self.learning_rate,
+            momentum_final=self.momentum, exaggeration=self.exaggeration,
+            exaggeration_iters=min(250, self.n_iter // 2))
+        self.embedding_ = np.asarray(y)
+        self.kl_curve_ = np.asarray(kls)
+        return self.embedding_
+
+    fit = fit_transform

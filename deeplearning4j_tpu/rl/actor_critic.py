@@ -1,0 +1,263 @@
+"""Advantage actor-critic: A3C (async workers) + vectorized A2C.
+
+Reference: rl4j ``org.deeplearning4j.rl4j.learning.async.a3c.discrete.
+A3CDiscrete`` — N async worker threads, each rolling out t_max steps in its
+own environment copy, computing n-step advantage gradients, and applying
+them to a shared global network (``AsyncGlobal``).
+
+TPU-native inversion: the data plane is a SINGLE jitted update (policy +
+value joint loss, n-step returns, entropy bonus). Two drivers share it:
+- :class:`A3CDiscrete` — faithful async semantics: worker THREADS with
+  private env copies push gradients into the global params under a lock
+  (the reference's design, useful for slow/host-bound envs).
+- :class:`A2CVectorized` — the accelerator-shaped equivalent: one batched
+  rollout across N env copies per update (synchronous A3C == A2C), the
+  whole update one XLA executable.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ------------------------------------------------------------- policy net
+
+
+def init_actor_critic(key, n_in: int, n_actions: int, hidden=(64, 64),
+                      dtype=jnp.float32) -> Dict[str, Any]:
+    """Separate torso → (policy logits, value) heads (rl4j
+    ActorCriticFactorySeparateStdDense equivalent, merged torso)."""
+    params: Dict[str, Any] = {}
+    sizes = (n_in,) + tuple(hidden)
+    keys = jax.random.split(key, len(hidden) + 2)
+    for i in range(len(hidden)):
+        params[f"h{i}"] = {
+            "W": (jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+                  * np.sqrt(2.0 / sizes[i])).astype(dtype),
+            "b": jnp.zeros(sizes[i + 1], dtype),
+        }
+    params["pi"] = {"W": (jax.random.normal(keys[-2], (sizes[-1], n_actions))
+                          * 0.01).astype(dtype),
+                    "b": jnp.zeros(n_actions, dtype)}
+    params["v"] = {"W": (jax.random.normal(keys[-1], (sizes[-1], 1))
+                         * 0.01).astype(dtype),
+                   "b": jnp.zeros(1, dtype)}
+    return params
+
+
+def actor_critic_forward(params, obs):
+    h = obs
+    i = 0
+    while f"h{i}" in params:
+        h = jax.nn.relu(h @ params[f"h{i}"]["W"] + params[f"h{i}"]["b"])
+        i += 1
+    logits = h @ params["pi"]["W"] + params["pi"]["b"]
+    value = (h @ params["v"]["W"] + params["v"]["b"])[..., 0]
+    return logits, value
+
+
+def _ac_loss(params, obs, actions, returns, *, vf_coef: float, ent_coef: float):
+    logits, values = actor_critic_forward(params, obs)
+    logp = jax.nn.log_softmax(logits)
+    adv = jax.lax.stop_gradient(returns - values)
+    pg = -jnp.mean(jnp.take_along_axis(logp, actions[:, None], axis=1)[:, 0] * adv)
+    vf = jnp.mean(jnp.square(returns - values))
+    ent = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, axis=-1))
+    return pg + vf_coef * vf - ent_coef * ent
+
+
+@dataclass
+class A3CConfiguration:
+    """rl4j A3CConfiguration field parity."""
+
+    seed: int = 0
+    max_epoch_step: int = 200
+    t_max: int = 8
+    gamma: float = 0.99
+    learning_rate: float = 7e-4
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    num_threads: int = 2
+
+
+def _make_update(cfg: A3CConfiguration):
+    @jax.jit
+    def update(params, opt, obs, actions, returns):
+        loss, grads = jax.value_and_grad(_ac_loss)(
+            params, obs, actions, returns,
+            vf_coef=cfg.vf_coef, ent_coef=cfg.ent_coef)
+        # RMSProp (the reference's updater for A3C)
+        new_opt = jax.tree.map(lambda s, g: 0.99 * s + 0.01 * g * g, opt, grads)
+        params = jax.tree.map(
+            lambda p, g, s: p - cfg.learning_rate * g / (jnp.sqrt(s) + 1e-5),
+            params, grads, new_opt)
+        return params, new_opt, loss
+
+    return update
+
+
+def _nstep_returns(rewards, bootstrap, dones, gamma):
+    """Backward n-step discounted returns (host-side, tiny arrays)."""
+    out = np.zeros(len(rewards), np.float32)
+    r = bootstrap
+    for t in reversed(range(len(rewards))):
+        r = rewards[t] + gamma * r * (1.0 - dones[t])
+        out[t] = r
+    return out
+
+
+class A3CDiscrete:
+    """Async worker threads + shared global params (reference semantics)."""
+
+    def __init__(self, mdp_factory: Callable[[], Any], cfg: A3CConfiguration,
+                 n_in: int, n_actions: int):
+        self.cfg = cfg
+        self.mdp_factory = mdp_factory
+        self.params = init_actor_critic(jax.random.key(cfg.seed), n_in, n_actions)
+        self.opt = jax.tree.map(jnp.zeros_like, self.params)
+        self._update = _make_update(cfg)
+        self._lock = threading.Lock()
+        self.episode_rewards: List[float] = []
+
+    def train(self, total_steps: int = 5000):
+        threads = [threading.Thread(target=self._worker,
+                                    args=(i, total_steps // self.cfg.num_threads))
+                   for i in range(self.cfg.num_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self
+
+    def _worker(self, widx: int, steps: int):
+        cfg = self.cfg
+        env = self.mdp_factory()
+        rng = np.random.RandomState(cfg.seed * 997 + widx)
+        obs = env.reset()
+        ep_reward, done_steps = 0.0, 0
+        while done_steps < steps:
+            with self._lock:
+                params = self.params
+            traj_o, traj_a, traj_r, traj_d = [], [], [], []
+            for _ in range(cfg.t_max):
+                logits, _ = actor_critic_forward(params, jnp.asarray(obs)[None])
+                p = np.asarray(jax.nn.softmax(logits[0]))
+                a = int(rng.choice(len(p), p=p / p.sum()))
+                nxt, r, done, _ = env.step(a)
+                traj_o.append(np.asarray(obs, np.float32))
+                traj_a.append(a)
+                traj_r.append(r)
+                traj_d.append(float(done))
+                ep_reward += r
+                obs = nxt
+                done_steps += 1
+                if done:
+                    self.episode_rewards.append(ep_reward)
+                    ep_reward = 0.0
+                    obs = env.reset()
+                    break
+            if traj_d[-1]:
+                boot = 0.0
+            else:
+                _, v = actor_critic_forward(params, jnp.asarray(obs)[None])
+                boot = float(v[0])
+            rets = _nstep_returns(np.asarray(traj_r, np.float32), boot,
+                                  np.asarray(traj_d, np.float32), cfg.gamma)
+            with self._lock:
+                self.params, self.opt, _ = self._update(
+                    self.params, self.opt, jnp.asarray(np.stack(traj_o)),
+                    jnp.asarray(np.asarray(traj_a, np.int32)), jnp.asarray(rets))
+
+    def policy(self):
+        return ACPolicy(self.params)
+
+
+class A2CVectorized:
+    """Synchronous batched A3C: N env copies stepped together, one jitted
+    update per rollout — the accelerator-shaped training mode."""
+
+    def __init__(self, mdp_factory: Callable[[], Any], cfg: A3CConfiguration,
+                 n_in: int, n_actions: int, n_envs: int = 8):
+        self.cfg = cfg
+        self.envs = [mdp_factory() for _ in range(n_envs)]
+        self.params = init_actor_critic(jax.random.key(cfg.seed), n_in, n_actions)
+        self.opt = jax.tree.map(jnp.zeros_like, self.params)
+        self._update = _make_update(cfg)
+        self.episode_rewards: List[float] = []
+
+    def train(self, updates: int = 200):
+        cfg = self.cfg
+        rng = np.random.RandomState(cfg.seed)
+        obs = np.stack([e.reset() for e in self.envs]).astype(np.float32)
+        ep_rew = np.zeros(len(self.envs))
+        for _ in range(updates):
+            O, Aa, Rr, Dd = [], [], [], []
+            for _ in range(cfg.t_max):
+                logits, _ = actor_critic_forward(self.params, jnp.asarray(obs))
+                probs = np.asarray(jax.nn.softmax(logits))
+                acts = np.array([rng.choice(probs.shape[1], p=p / p.sum())
+                                 for p in probs])
+                nxt, rew, done = [], [], []
+                for e, o, a in zip(self.envs, obs, acts):
+                    n, r, d, _ = e.step(int(a))
+                    if d:
+                        n = e.reset()
+                    nxt.append(n)
+                    rew.append(r)
+                    done.append(float(d))
+                O.append(obs.copy())
+                Aa.append(acts)
+                Rr.append(np.asarray(rew, np.float32))
+                Dd.append(np.asarray(done, np.float32))
+                ep_rew += np.asarray(rew)
+                for j, d in enumerate(done):
+                    if d:
+                        self.episode_rewards.append(float(ep_rew[j]))
+                        ep_rew[j] = 0.0
+                obs = np.stack(nxt).astype(np.float32)
+            _, v = actor_critic_forward(self.params, jnp.asarray(obs))
+            boot = np.asarray(v)
+            rets = np.zeros((cfg.t_max, len(self.envs)), np.float32)
+            r = boot
+            for t in reversed(range(cfg.t_max)):
+                r = Rr[t] + cfg.gamma * r * (1.0 - Dd[t])
+                rets[t] = r
+            self.params, self.opt, _ = self._update(
+                self.params, self.opt,
+                jnp.asarray(np.concatenate(O)),
+                jnp.asarray(np.concatenate(Aa).astype(np.int32)),
+                jnp.asarray(rets.reshape(-1)))
+        return self
+
+    def policy(self):
+        return ACPolicy(self.params)
+
+
+class ACPolicy:
+    """Greedy policy over the trained actor (rl4j ACPolicy)."""
+
+    def __init__(self, params):
+        self.params = params
+
+    def next_action(self, obs) -> int:
+        logits, _ = actor_critic_forward(self.params, jnp.asarray(obs)[None])
+        return int(jnp.argmax(logits[0]))
+
+    nextAction = next_action
+
+    def play(self, env, max_steps: int = 200) -> float:
+        obs = env.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done, _ = env.step(self.next_action(obs))
+            total += r
+            if done:
+                break
+        return total

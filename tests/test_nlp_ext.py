@@ -1,0 +1,119 @@
+"""GloVe / ParagraphVectors / tSNE (SURVEY §2.5 P5)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.tsne import BarnesHutTsne
+
+
+def _two_topic_corpus(n=300, seed=0):
+    """Sentences drawn from two disjoint topic vocabularies: embeddings must
+    put same-topic words closer than cross-topic words."""
+    rs = np.random.RandomState(seed)
+    animals = ["cat", "dog", "fox", "wolf", "bear", "lion"]
+    tools = ["hammer", "wrench", "drill", "saw", "pliers", "chisel"]
+    out = []
+    for _ in range(n):
+        vocab = animals if rs.rand() < 0.5 else tools
+        out.append(" ".join(rs.choice(vocab, size=rs.randint(5, 10))))
+    return out, animals, tools
+
+
+class TestGlove:
+    def test_learns_topic_structure(self):
+        sentences, animals, tools = _two_topic_corpus()
+        g = (Glove.Builder().layer_size(24).window_size(4).epochs(40)
+             .learning_rate(0.1).seed(7).iterate(sentences).build())
+        g.fit()
+        same = g.similarity("cat", "dog")
+        cross = g.similarity("cat", "hammer")
+        assert same > cross, (same, cross)
+        assert g.loss_curve[-1] < g.loss_curve[0]
+
+    def test_word_vector_and_nearest(self):
+        sentences, animals, tools = _two_topic_corpus()
+        g = Glove(layer_size=16, window=4, epochs=25, learning_rate=0.1, seed=3)
+        g.fit(sentences)
+        assert g.get_word_vector("cat").shape == (16,)
+        near = g.words_nearest("cat", 3)
+        assert len(near) == 3
+
+
+class TestParagraphVectors:
+    def _docs(self, n=120, seed=1):
+        rs = np.random.RandomState(seed)
+        animals = ["cat", "dog", "fox", "wolf", "bear", "lion"]
+        tools = ["hammer", "wrench", "drill", "saw", "pliers", "chisel"]
+        docs = []
+        for i in range(n):
+            topic = "animal" if i % 2 == 0 else "tool"
+            vocab = animals if topic == "animal" else tools
+            docs.append((f"{topic}_{i}", " ".join(rs.choice(vocab, size=rs.randint(8, 14)))))
+        return docs
+
+    def test_doc_vectors_cluster_by_topic(self):
+        docs = self._docs()
+        pv = ParagraphVectors(layer_size=24, window=3, epochs=80,
+                              learning_rate=0.05, batch_size=128, seed=5)
+        pv.fit(docs)
+        a = np.stack([pv.get_vector(l) for l, _ in docs if l.startswith("animal")])
+        t = np.stack([pv.get_vector(l) for l, _ in docs if l.startswith("tool")])
+
+        def cos(u, v):
+            return (u @ v) / (np.linalg.norm(u) * np.linalg.norm(v) + 1e-12)
+
+        within = cos(a.mean(0), a[0]) + cos(t.mean(0), t[0])
+        across = cos(a.mean(0), t[0]) + cos(t.mean(0), a[0])
+        assert within > across, (within, across)
+
+    def test_infer_vector_lands_near_topic(self):
+        docs = self._docs()
+        pv = ParagraphVectors(layer_size=24, window=3, epochs=80,
+                              learning_rate=0.05, batch_size=128, seed=5)
+        pv.fit(docs)
+        v = pv.infer_vector("cat dog wolf bear cat lion dog", steps=100,
+                            learning_rate=0.1)
+        near = pv.nearest_labels(v, 10)
+        animal_frac = sum(1 for l in near if l.startswith("animal")) / len(near)
+        assert animal_frac >= 0.7, near
+
+    def test_dbow_mode_trains(self):
+        docs = self._docs(40)
+        pv = ParagraphVectors(layer_size=12, window=3, epochs=5, dm=False,
+                              train_words=False, seed=2)
+        pv.fit(docs)
+        assert pv.doc_vectors.shape == (40, 12)
+
+        assert np.all(np.isfinite(pv.doc_vectors))
+
+    def test_dbow_with_train_words_raises(self):
+        pv = ParagraphVectors(dm=False, train_words=True)
+        with pytest.raises(ValueError, match="PV-DBOW"):
+            pv.fit(self._docs(4))
+
+
+class TestTsne:
+    def test_clusters_stay_separated(self):
+        rs = np.random.RandomState(0)
+        centers = np.array([[8.0] * 10, [-8.0] * 10, [8.0] * 5 + [-8.0] * 5])
+        x = np.concatenate([c + rs.randn(25, 10) for c in centers]).astype(np.float32)
+        labels = np.repeat([0, 1, 2], 25)
+        ts = BarnesHutTsne(perplexity=10, n_iter=300, learning_rate=100.0, seed=1)
+        y = ts.fit_transform(x)
+        assert y.shape == (75, 2)
+        # 1-NN purity in the embedding: same-cluster neighbors dominate
+        d = ((y[:, None] - y[None]) ** 2).sum(-1)
+        np.fill_diagonal(d, np.inf)
+        nn = d.argmin(1)
+        purity = float(np.mean(labels[nn] == labels))
+        assert purity > 0.9, purity
+        assert ts.kl_curve_[-1] < ts.kl_curve_[0]
+
+    def test_builder_surface(self):
+        ts = (BarnesHutTsne.Builder().set_max_iter(100).perplexity(5.0)
+              .learning_rate(50.0).theta(0.5).seed(4).build())
+        x = np.random.RandomState(2).randn(30, 6).astype(np.float32)
+        y = ts.fit_transform(x)
+        assert y.shape == (30, 2)

@@ -1,0 +1,33 @@
+"""Zoo breadth wave (SURVEY §2.4 C15): init + forward + one train step on
+small input shapes."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import AlexNet, Darknet19, SqueezeNet, UNet, Xception
+
+
+@pytest.mark.parametrize("zoo,shape,classes", [
+    (lambda: AlexNet(num_classes=7, input_shape=(3, 67, 67)), (3, 67, 67), 7),
+    (lambda: Darknet19(num_classes=7, input_shape=(3, 64, 64)), (3, 64, 64), 7),
+    (lambda: SqueezeNet(num_classes=7, input_shape=(3, 64, 64)), (3, 64, 64), 7),
+    (lambda: Xception(num_classes=7, input_shape=(3, 32, 32), middle_blocks=1),
+     (3, 32, 32), 7),
+])
+def test_classifier_zoo_forward(zoo, shape, classes):
+    net = zoo().init()
+    x = np.random.RandomState(0).randn(2, *shape).astype(np.float32)
+    out = net.output(x)
+    arr = np.asarray(out[0].numpy() if isinstance(out, list) else out.numpy())
+    assert arr.shape == (2, classes)
+    np.testing.assert_allclose(arr.sum(-1), 1.0, rtol=1e-4)  # softmax head
+
+
+def test_unet_segmentation_shape():
+    net = UNet(n_channels_out=1, input_shape=(3, 32, 32), base_filters=4,
+               depth=2).init()
+    x = np.random.RandomState(1).randn(2, 3, 32, 32).astype(np.float32)
+    out = net.output(x)
+    arr = np.asarray(out[0].numpy() if isinstance(out, list) else out.numpy())
+    assert arr.shape == (2, 1, 32, 32)
+    assert 0.0 <= arr.min() and arr.max() <= 1.0  # sigmoid map
