@@ -1,3 +1,11 @@
-from .evaluation import Evaluation, RegressionEvaluation, ROC, EvaluationBinary
+from .evaluation import (
+    Evaluation,
+    EvaluationBinary,
+    EvaluationCalibration,
+    RegressionEvaluation,
+    ROC,
+    ROCMultiClass,
+)
 
-__all__ = ["Evaluation", "RegressionEvaluation", "ROC", "EvaluationBinary"]
+__all__ = ["Evaluation", "RegressionEvaluation", "ROC", "EvaluationBinary",
+           "ROCMultiClass", "EvaluationCalibration"]

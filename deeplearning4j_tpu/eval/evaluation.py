@@ -10,7 +10,7 @@ the data-parallel evaluator).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -246,3 +246,114 @@ class RegressionEvaluation:
     def r_squared(self, col: int = 0) -> float:
         ss_tot = self.sum_y2[col] - self.sum_y[col] ** 2 / self.n
         return float(1.0 - self.sum_err2[col] / ss_tot) if ss_tot > 0 else 0.0
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (org.nd4j.evaluation.classification
+    .ROCMultiClass): labels one-hot [N, C], predictions probabilities
+    [N, C]; per-class AUC + macro average, mergeable across workers."""
+
+    def __init__(self, threshold_steps: int = 200):
+        self.steps = threshold_steps
+        self._rocs: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        if y.ndim == 3:  # DL4J time-series layout [N, C, T] → class axis LAST
+            y = np.moveaxis(y, 1, -1)
+            p = np.moveaxis(p, 1, -1)
+        C = y.shape[-1]
+        for c in range(C):
+            self._rocs.setdefault(c, ROC(self.steps)).eval(
+                y[..., c], p[..., c], mask=mask)
+
+    def merge(self, other: "ROCMultiClass") -> "ROCMultiClass":
+        for c, roc in other._rocs.items():
+            if c not in self._rocs:
+                # fresh accumulator, then merge: aliasing other's ROC would
+                # double-count when either side keeps evaling after merge
+                self._rocs[c] = ROC(roc.steps)
+            self._rocs[c].merge(roc)
+        return self
+
+    def calculate_auc(self, class_idx: int) -> float:
+        return self._rocs[class_idx].calculate_auc()
+
+    calculateAUC = calculate_auc
+
+    def calculate_average_auc(self) -> float:
+        if not self._rocs:
+            return 0.0
+        return float(np.mean([r.calculate_auc() for r in self._rocs.values()]))
+
+    calculateAverageAUC = calculate_average_auc
+
+    def num_classes(self) -> int:
+        return len(self._rocs)
+
+
+class EvaluationCalibration:
+    """Reliability diagram + residual-plot data (org.nd4j.evaluation
+    .classification.EvaluationCalibration): bins predicted confidence vs
+    observed accuracy; expected calibration error (ECE) summary."""
+
+    def __init__(self, reliability_bins: int = 10):
+        self.bins = reliability_bins
+        self._counts = np.zeros(reliability_bins, np.int64)
+        self._correct = np.zeros(reliability_bins, np.int64)
+        self._conf_sum = np.zeros(reliability_bins, np.float64)
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        if y.ndim == 3:  # DL4J time-series layout [N, C, T] → class axis last
+            y = np.moveaxis(y, 1, -1)
+            p = np.moveaxis(p, 1, -1)
+        if mask is not None:
+            m = _to_np(mask).astype(bool).reshape(-1)
+            y = y.reshape(-1, y.shape[-1])[m]
+            p = p.reshape(-1, p.shape[-1])[m]
+        else:
+            y = y.reshape(-1, y.shape[-1])
+            p = p.reshape(-1, p.shape[-1])
+        conf = p.max(-1)
+        correct = p.argmax(-1) == y.argmax(-1)
+        idx = np.clip((conf * self.bins).astype(int), 0, self.bins - 1)
+        np.add.at(self._counts, idx, 1)
+        np.add.at(self._correct, idx, correct.astype(np.int64))
+        np.add.at(self._conf_sum, idx, conf)
+
+    def merge(self, other: "EvaluationCalibration") -> "EvaluationCalibration":
+        self._counts += other._counts
+        self._correct += other._correct
+        self._conf_sum += other._conf_sum
+        return self
+
+    def reliability_diagram(self):
+        """[(bin_center, mean_confidence, observed_accuracy, count)] rows."""
+        out = []
+        for b in range(self.bins):
+            n = int(self._counts[b])
+            center = (b + 0.5) / self.bins
+            if n == 0:
+                out.append((center, center, float("nan"), 0))
+            else:
+                out.append((center, float(self._conf_sum[b] / n),
+                            float(self._correct[b] / n), n))
+        return out
+
+    getReliabilityInfo = reliability_diagram
+
+    def expected_calibration_error(self) -> float:
+        total = self._counts.sum()
+        if total == 0:
+            return 0.0
+        ece = 0.0
+        for b in range(self.bins):
+            n = self._counts[b]
+            if n:
+                acc = self._correct[b] / n
+                conf = self._conf_sum[b] / n
+                ece += (n / total) * abs(acc - conf)
+        return float(ece)

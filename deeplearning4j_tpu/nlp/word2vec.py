@@ -205,7 +205,7 @@ class Word2Vec:
                  negative: int = 5, subsampling: float = 1e-3, learning_rate: float = 0.025,
                  min_learning_rate: float = 1e-4, epochs: int = 1, batch_size: int = 512,
                  seed: int = 42, tokenizer_factory=None, cbow: bool = False,
-                 hs: bool = False):
+                 hs: bool = False, mesh=None):
         if negative <= 0 and not hs:
             raise ValueError(
                 "no training objective: set negative > 0 (negative sampling) "
@@ -229,6 +229,13 @@ class Word2Vec:
         self.syn1: Optional[np.ndarray] = None  # HS inner-node table
         self._sample_table: Optional[np.ndarray] = None
         self._sentences = None
+        # distributed embedding tables (SURVEY §2.10 'distributed embedding
+        # (PS)' row / §2.2 J17): with a mesh, syn0/syn1 rows shard over the
+        # mesh's first axis — the TPU-native successor of the reference's
+        # VoidParameterServer vocab shards (gather/update collectives are
+        # compiled into the epoch executable by GSPMD, replacing the PS
+        # request/response protocol)
+        self.mesh = mesh
 
     # ------------------------------------------------------------ builder
 
@@ -312,6 +319,24 @@ class Word2Vec:
             w._sentences = self._iter
             return w
 
+    # ------------------------------------------------------------ placement
+
+    def _place_table(self, table):
+        """Distributed embedding placement (J17): rows shard over the mesh's
+        first axis. The epoch executable's gathers/aggregations then compile
+        into GSPMD collectives — the PS request/response protocol of
+        ref:`VoidParameterServer` collapses into in-step all-gathers."""
+        if self.mesh is None:
+            return table
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = self.mesh.axis_names[0]
+        if table.shape[0] % self.mesh.shape[axis]:
+            spec = P()  # vocab not divisible: replicate rather than crash
+        else:
+            spec = P(axis, None)
+        return jax.device_put(table, NamedSharding(self.mesh, spec))
+
     # ---------------------------------------------------------------- fit
 
     def fit(self, sentences: Optional[Iterable[str]] = None) -> "Word2Vec":
@@ -323,12 +348,12 @@ class Word2Vec:
         rs = np.random.RandomState(self.seed)
         # InMemoryLookupTable.resetWeights: syn0 ~ U(-0.5,0.5)/dim, syn1 zeros
         self.syn0 = ((rs.rand(V, D).astype(np.float32) - 0.5) / D)
-        syn0 = jnp.asarray(self.syn0)
+        syn0 = self._place_table(jnp.asarray(self.syn0))
         syn1 = syn1h = None
         points = codes = pmask = None
         if self.negative > 0:
             self.syn1neg = np.zeros((V, D), np.float32)
-            syn1 = jnp.asarray(self.syn1neg)
+            syn1 = self._place_table(jnp.asarray(self.syn1neg))
             self._build_sample_table()
         if self.hs:
             # Huffman paths → padded [V, L] (points, codes, mask) lookup
@@ -344,7 +369,7 @@ class Word2Vec:
                 codes[i, :n] = w.codes
                 pmask[i, :n] = 1.0
             self.syn1 = np.zeros((max(V - 1, 1), D), np.float32)
-            syn1h = jnp.asarray(self.syn1)
+            syn1h = self._place_table(jnp.asarray(self.syn1))
             points, codes, pmask = (jnp.asarray(a) for a in (points, codes, pmask))
 
         flat, sent_id = self._corpus_arrays(sentences, rs)

@@ -83,3 +83,69 @@ class ProfileAnalyzer:
     def load(path: str) -> Dict[str, Any]:
         with open(path) as f:
             return json.load(f)
+
+
+class DeviceProfiler:
+    """Device-level (XPlane) profile capture — SURVEY §5.1's missing tier.
+
+    The reference's deepest profiling layer is libnd4j's op-level
+    ``OpProfiler``; the TPU equivalent of "what did the DEVICE actually do"
+    is the XLA/XPlane profiler. This wraps ``jax.profiler`` into the same
+    listener-ish vocabulary: use as a context manager around train steps (or
+    ``start()``/``stop()``), producing a TensorBoard-loadable XPlane dump
+    with per-HLO device timings + host traces.
+
+        with DeviceProfiler(logdir):
+            net.fit(ds)
+
+    Pair with :class:`ProfilingListener` (host-side cadence) for the full
+    picture: XPlane says what the chip did, the listener says when the host
+    let it.
+    """
+
+    def __init__(self, logdir: str, host_tracer_level: int = 2):
+        self.logdir = logdir
+        self.host_tracer_level = host_tracer_level
+        self._active = False
+
+    def start(self) -> "DeviceProfiler":
+        import jax
+
+        if self._active:
+            return self  # idempotent: jax raises on double-start
+        jax.profiler.start_trace(self.logdir,
+                                 create_perfetto_link=False,
+                                 create_perfetto_trace=False)
+        self._active = True
+        return self
+
+    def stop(self) -> str:
+        import jax
+
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+        return self.logdir
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def trace_files(self):
+        """The captured .xplane.pb artifacts (one per capture)."""
+        import glob
+        import os
+
+        return sorted(glob.glob(os.path.join(
+            self.logdir, "**", "*.xplane.pb"), recursive=True))
+
+    @staticmethod
+    def annotate(name: str):
+        """Named region visible on the device timeline
+        (jax.profiler.TraceAnnotation)."""
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)

@@ -163,3 +163,26 @@ def test_bert_iterator_classification():
     mds = next(iter(it))
     assert mds.labels[0].shape == (2, 2)
     np.testing.assert_allclose(mds.labels[0], [[1, 0], [0, 1]])
+
+
+def test_w2v_sharded_embedding_tables_match_single_device():
+    """J17 distributed embedding: tables sharded over a mesh axis train to
+    the same vectors as the single-device run (GSPMD collectives replace the
+    reference's parameter-server protocol)."""
+    import jax
+    from jax.sharding import Mesh
+
+    rs = np.random.RandomState(0)
+    vocab = [f"w{i}" for i in range(64)]
+    sentences = [" ".join(rs.choice(vocab, size=rs.randint(6, 12)))
+                 for _ in range(200)]
+
+    ref = Word2Vec(layer_size=16, window=3, negative=5, epochs=2,
+                   batch_size=512, seed=9)
+    ref.fit(sentences)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    sharded = Word2Vec(layer_size=16, window=3, negative=5, epochs=2,
+                       batch_size=512, seed=9, mesh=mesh)
+    sharded.fit(sentences)
+    np.testing.assert_allclose(sharded.syn0, ref.syn0, rtol=1e-4, atol=1e-5)

@@ -126,3 +126,56 @@ def test_transfer_learning_helper_featurize():
     out_full = base.output(X).numpy()
     out_head = head.output(feat.features).numpy()
     np.testing.assert_allclose(out_full, out_head, atol=1e-5)
+
+
+class TestROCMultiClassAndCalibration:
+    """J10 tail: ROCMultiClass + EvaluationCalibration (mergeable)."""
+
+    def _data(self, n=400, C=3, seed=0):
+        rs = np.random.RandomState(seed)
+        y = rs.randint(0, C, n)
+        logits = rs.randn(n, C) * 0.5
+        logits[np.arange(n), y] += 2.0  # informative predictions
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        return np.eye(C)[y].astype(np.float32), p.astype(np.float32)
+
+    def test_roc_multiclass_auc(self):
+        from deeplearning4j_tpu.eval import ROCMultiClass
+
+        y, p = self._data()
+        roc = ROCMultiClass()
+        roc.eval(y[:200], p[:200])
+        other = ROCMultiClass()
+        other.eval(y[200:], p[200:])
+        roc.merge(other)
+        assert roc.num_classes() == 3
+        for c in range(3):
+            assert roc.calculate_auc(c) > 0.85
+        assert roc.calculate_average_auc() > 0.85
+        # random scores → AUC near 0.5
+        rand = ROCMultiClass()
+        rs = np.random.RandomState(1)
+        pr = rs.rand(400, 3); pr /= pr.sum(-1, keepdims=True)
+        rand.eval(y, pr.astype(np.float32))
+        assert abs(rand.calculate_average_auc() - 0.5) < 0.1
+
+    def test_calibration_ece_and_reliability(self):
+        from deeplearning4j_tpu.eval import EvaluationCalibration
+
+        y, p = self._data()
+        cal = EvaluationCalibration(reliability_bins=10)
+        cal.eval(y[:200], p[:200])
+        other = EvaluationCalibration(reliability_bins=10)
+        other.eval(y[200:], p[200:])
+        cal.merge(other)
+        rows = cal.reliability_diagram()
+        assert len(rows) == 10
+        assert sum(r[3] for r in rows) == 400
+        ece = cal.expected_calibration_error()
+        assert 0.0 <= ece <= 1.0
+        # degenerate overconfident predictions → large ECE
+        bad = EvaluationCalibration()
+        yb = np.eye(2)[np.zeros(100, int)].astype(np.float32)
+        pb = np.tile(np.array([[0.01, 0.99]], np.float32), (100, 1))  # always wrong
+        bad.eval(yb, pb)
+        assert bad.expected_calibration_error() > 0.9

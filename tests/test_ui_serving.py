@@ -110,3 +110,20 @@ def test_json_model_server_roundtrip():
         np.testing.assert_allclose(out2, ref, atol=1e-5)
     finally:
         server.stop()
+
+
+def test_device_profiler_captures_xplane(tmp_path):
+    """SURVEY §5.1: device-level XPlane capture (the chrome-trace listener is
+    host-side only; this is the on-device tier)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ui.profiling import DeviceProfiler
+
+    prof = DeviceProfiler(str(tmp_path / "prof"))
+    with prof:
+        with DeviceProfiler.annotate("matmul_region"):
+            a = jnp.ones((64, 64))
+            jax.block_until_ready(jax.jit(lambda x: x @ x)(a))
+    files = prof.trace_files()
+    assert files, "no .xplane.pb produced"
