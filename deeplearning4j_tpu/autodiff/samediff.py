@@ -258,12 +258,43 @@ class SameDiff:
                       dict(kwargs or {}), n_outputs)
         self.ops.append(node)
         self._fn_cache.clear()
+        # shape-fn contract (SURVEY §2.1 N5 calculateOutputShape): output
+        # shapes/dtypes inferred AT GRAPH BUILD via jax.eval_shape over
+        # abstract inputs — no execution, and every registry op gets it for
+        # free (the reference hand-writes ~500 DECLARE_SHAPE_FN bodies)
+        shapes = self._infer_shapes(node, inputs)
         outs = []
-        for on in out_names:
-            v = SDVariable(self, on, VariableType.ARRAY)
+        for i, on in enumerate(out_names):
+            sh, dt = shapes[i] if shapes and i < len(shapes) else (None, None)
+            v = SDVariable(self, on, VariableType.ARRAY, sh, dt)
             self.vars[on] = v
             outs.append(v)
         return outs[0] if n_outputs == 1 else tuple(outs)
+
+    def _infer_shapes(self, node: "OpNode", inputs: List[SDVariable]):
+        """[(shape, dtype)] per output, or None when an input shape is
+        unknown (shapeless placeholder) or the op resists abstract eval."""
+        from .control_flow import CONTROL_OPS
+
+        if node.op_name in CONTROL_OPS:
+            return None
+        specs = []
+        for v in inputs:
+            if v.name in self.arrays:
+                a = self.arrays[v.name]
+                specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+            elif v.shape is not None and None not in v.shape:
+                specs.append(jax.ShapeDtypeStruct(
+                    tuple(v.shape), v.dtype or jnp.float32))
+            else:
+                return None
+        try:
+            out = jax.eval_shape(
+                lambda *xs: get_op(node.op_name)(*xs, **node.kwargs), *specs)
+        except Exception:
+            return None  # e.g. rng-keyed ops or data-dependent shapes
+        leaves = out if isinstance(out, (tuple, list)) else [out]
+        return [(tuple(l.shape), l.dtype) for l in leaves]
 
     def op(self, op_name: str, *inputs, name: Optional[str] = None, n_outputs: int = 1, **kwargs):
         """Generic escape hatch: sd.op("gelu", x)."""

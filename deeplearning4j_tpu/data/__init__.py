@@ -1,5 +1,11 @@
 from .dataset import DataSet, MultiDataSet
-from .datasets import IrisDataSetIterator, MnistDataSetIterator
+from .datasets import (
+    Cifar10DataSetIterator,
+    EmnistDataSetIterator,
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+    TinyImageNetDataSetIterator,
+)
 from .iterators import (
     DataSetIterator,
     ListDataSetIterator,
@@ -36,6 +42,9 @@ from .records import (
 from .transform import Schema, TransformProcess
 
 __all__ = [
+    "Cifar10DataSetIterator",
+    "EmnistDataSetIterator",
+    "TinyImageNetDataSetIterator",
     "ImageRecordReader",
     "ImageRecordReaderDataSetIterator",
     "ImageTransform",

@@ -44,21 +44,13 @@ def _find_mnist_dir() -> Optional[str]:
 
 
 def _synthetic_mnist(n: int, seed: int, train: bool) -> Tuple[np.ndarray, np.ndarray]:
-    """Deterministic digit-like 28x28 data: each class = a fixed random
-    low-frequency template; samples = template + jitter + noise. Linearly
-    separable enough that LeNet reaches high accuracy, hard enough that an
-    untrained net doesn't."""
-    rs = np.random.RandomState(1234)  # templates fixed across train/test
-    templates = rs.rand(10, 7, 7).astype(np.float32)
-    rs2 = np.random.RandomState(seed + (0 if train else 10_000))
-    labels = rs2.randint(0, 10, n)
-    imgs = np.empty((n, 28, 28), np.float32)
-    for i, c in enumerate(labels):
-        t = np.kron(templates[c], np.ones((4, 4), np.float32))  # 28x28
-        shift = rs2.randint(-2, 3, 2)
-        t = np.roll(t, tuple(shift), axis=(0, 1))
-        imgs[i] = np.clip(t + 0.15 * rs2.randn(28, 28), 0, 1)
-    return (imgs * 255).astype(np.uint8), labels
+    """Deterministic digit-like 28x28 data (see _synthetic_images; this
+    wrapper preserves the original MNIST RNG stream bit-exactly via
+    template_seed=1234 — rand(10,1,7,7)/randn(1,28,28) draw the same values
+    as the historical rand(10,7,7)/randn(28,28))."""
+    imgs, labels = _synthetic_images(n, seed, train, classes=10, hw=28,
+                                     channels=1, template_seed=1234)
+    return imgs[:, 0], labels
 
 
 class MnistDataSetIterator(DataSetIterator):
@@ -164,3 +156,98 @@ class IrisDataSetIterator(DataSetIterator):
         if not self.has_next():
             raise StopIteration
         return self.next()
+
+
+def _synthetic_images(n: int, seed: int, train: bool, classes: int,
+                      hw: int, channels: int,
+                      template_seed: int = 4321) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-template images (one recipe for MNIST/Cifar/TinyImageNet
+    shapes): per-class low-frequency template + jitter + noise."""
+    rs = np.random.RandomState(template_seed)  # fixed across train/test
+    base = hw // 4
+    templates = rs.rand(classes, channels, base, base).astype(np.float32)
+    rs2 = np.random.RandomState(seed + (0 if train else 10_000))
+    labels = rs2.randint(0, classes, n)
+    up = np.ones((hw // base, hw // base), np.float32)
+    # upsample once per (class, channel), not once per example
+    big = np.stack([[np.kron(templates[c, ch], up) for ch in range(channels)]
+                    for c in range(classes)])
+    imgs = np.empty((n, channels, hw, hw), np.float32)
+    for i, c in enumerate(labels):
+        shift = rs2.randint(-2, 3, 2)
+        t = np.roll(big[c], tuple(shift), axis=(1, 2))
+        imgs[i] = np.clip(t + 0.15 * rs2.randn(channels, hw, hw), 0, 1)
+    return (imgs * 255).astype(np.uint8), labels
+
+
+class _SyntheticImageIterator(DataSetIterator):
+    """Shared driver for Cifar10/EMNIST/TinyImageNet-style iterators: local
+    files are not fetchable in the zero-egress build, so these serve the
+    DETERMINISTIC synthetic fallback (divergence documented; the MNIST
+    iterator's IDX-file path shows the file-loading shape these would take)."""
+
+    synthetic = True
+
+    def __init__(self, batch_size: int, train: bool, seed: int,
+                 num_examples: int, classes: int, hw: int, channels: int):
+        self.batch_size = batch_size
+        self.classes = classes
+        imgs, labels = _synthetic_images(num_examples, seed, train, classes,
+                                         hw, channels)
+        self._x = imgs.astype(np.float32) / 255.0
+        self._y = np.eye(classes, dtype=np.float32)[labels]
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._x)
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def next(self) -> DataSet:
+        s = slice(self._pos, self._pos + self.batch_size)
+        self._pos += self.batch_size
+        return DataSet(self._x[s], self._y[s])
+
+    def state(self) -> dict:
+        return {"pos": int(self._pos)}
+
+    def set_state(self, st: dict) -> None:
+        self._pos = int(st["pos"])
+
+
+class Cifar10DataSetIterator(_SyntheticImageIterator):
+    """org.deeplearning4j.datasets.iterator.impl.Cifar10DataSetIterator
+    (synthetic fallback: 10 classes, 32x32x3 NCHW)."""
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 123,
+                 num_examples: int = 5120):
+        super().__init__(batch_size, train, seed, num_examples,
+                         classes=10, hw=32, channels=3)
+
+
+class EmnistDataSetIterator(_SyntheticImageIterator):
+    """EMNIST letters split (26 classes, 28x28 grayscale; synthetic fallback)."""
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 123,
+                 num_examples: int = 5120, dataset: str = "LETTERS"):
+        splits = {"LETTERS": 26, "DIGITS": 10, "BALANCED": 47,
+                  "BYCLASS": 62, "BYMERGE": 47, "COMPLETE": 62, "MNIST": 10}
+        if dataset.upper() not in splits:
+            raise ValueError(f"unknown EMNIST split {dataset!r}; "
+                             f"known: {sorted(splits)}")
+        classes = splits[dataset.upper()]
+        super().__init__(batch_size, train, seed, num_examples,
+                         classes=classes, hw=28, channels=1)
+
+
+class TinyImageNetDataSetIterator(_SyntheticImageIterator):
+    """TinyImageNet (200 classes, 64x64x3; synthetic fallback)."""
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 123,
+                 num_examples: int = 2000):
+        super().__init__(batch_size, train, seed, num_examples,
+                         classes=200, hw=64, channels=3)

@@ -65,6 +65,14 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
 
+    def _html(self, body: str, code=200):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def _json(self, obj, code=200):
         body = json.dumps(obj).encode()
         self.send_response(code)
@@ -75,12 +83,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path in ("/", "/train", "/train/overview"):
-            body = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_PAGE)
             return
         if self.path == "/sessions":
             self._json(self.storage.session_ids())
@@ -106,7 +109,67 @@ class _Handler(BaseHTTPRequestHandler):
                 "ratios": ratios,
             })
             return
+        if self.path in ("/train/model", "/model"):
+            self._html(_model_page(getattr(self.server, "model_graph", None)))
+            return
+        if self.path == "/model/graph":
+            self._json(getattr(self.server, "model_graph", None) or
+                       {"error": "no model attached"})
+            return
         self._json({"error": "not found"}, 404)
+
+
+def model_graph_json(net) -> dict:
+    """Topology descriptor for the model tab (VertxUIServer's model-graph
+    FlatBuffers → plain JSON): nodes with layer class + param counts, edges
+    from the config wiring."""
+    import numpy as np
+
+    nodes, edges = [], []
+    conf = net.conf
+    if hasattr(conf, "nodes"):  # ComputationGraph
+        for inp in conf.network_inputs:
+            nodes.append({"name": inp, "type": "Input", "params": 0})
+        for name, node in conf.nodes.items():
+            kind = (type(node.layer).__name__ if node.layer is not None
+                    else type(node.vertex).__name__)
+            p = net.params_.get(name, {})
+            nodes.append({"name": name, "type": kind,
+                          "params": int(sum(np.prod(w.shape) for w in p.values()))})
+            for src in node.inputs:
+                edges.append([src, name])
+    else:  # MultiLayerNetwork
+        prev = "input"
+        nodes.append({"name": "input", "type": "Input", "params": 0})
+        for i, layer in enumerate(conf.layers):
+            name = f"{i}:{type(layer).__name__}"
+            p = net.params_.get(str(i), {})
+            nodes.append({"name": name, "type": type(layer).__name__,
+                          "params": int(sum(np.prod(w.shape) for w in p.values()))})
+            edges.append([prev, name])
+            prev = name
+    return {"nodes": nodes, "edges": edges}
+
+
+def _model_page(graph) -> str:
+    if not graph:
+        return "<html><body><h2>Model</h2><p>no model attached — " \
+               "UIServer.attach_model(net)</p></body></html>"
+    import html as _h
+
+    rows = "".join(
+        f"<tr><td>{_h.escape(str(n['name']))}</td><td>{_h.escape(str(n['type']))}</td>"
+        f"<td style='text-align:right'>{n['params']:,}</td></tr>"
+        for n in graph["nodes"])
+    edges = "".join(f"<li>{_h.escape(str(a))} &rarr; {_h.escape(str(b))}</li>"
+                    for a, b in graph["edges"])
+    total = sum(n["params"] for n in graph["nodes"])
+    return f"""<!DOCTYPE html><html><head><title>model</title>
+<style>body{{font-family:sans-serif;margin:20px}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head><body>
+<h2>Model graph — {len(graph['nodes'])} nodes, {total:,} params</h2>
+<table><tr><th>node</th><th>type</th><th>params</th></tr>{rows}</table>
+<h3>Edges</h3><ul>{edges}</ul></body></html>"""
 
 
 class UIServer:
@@ -134,6 +197,15 @@ class UIServer:
             self._start(storage)
         else:
             self._httpd.RequestHandlerClass.storage = storage
+
+    def attach_model(self, net) -> None:
+        """Populate the model tab (C14 model-graph tier): /train/model and
+        /model/graph serve the attached network's topology."""
+        if self._httpd is None:
+            self._start(self._storages[0] if self._storages else StatsStorage())
+        self._httpd.model_graph = model_graph_json(net)
+
+    attachModel = attach_model
 
     def _start(self, storage: StatsStorage):
         handler = type("BoundHandler", (_Handler,), {"storage": storage})

@@ -213,3 +213,29 @@ class TestControlFlow:
         sd.set_loss_variables("loss")
         grads = sd.calculate_gradients({"p": np.asarray(1.0)}, ["w"])
         np.testing.assert_allclose(np.asarray(grads["w"]), [4.0, 6.0])
+
+
+class TestShapeFnContract:
+    """N5 shape-function contract: output shapes known at GRAPH BUILD time
+    (the reference's DECLARE_SHAPE_FN, here via jax.eval_shape for every op)."""
+
+    def test_shapes_inferred_through_graph(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (4, 3))
+        w = sd.var("w", np.zeros((3, 8), np.float32))
+        h = sd.op("matmul", x, w)
+        assert h.shape == (4, 8)
+        r = sd.op("reduce_sum", h, dims=1)
+        assert r.shape == (4,)
+        s = sd.op("softmax", h)
+        assert s.shape == (4, 8) and str(s.dtype) == "float32"
+
+    def test_unknown_placeholder_shape_degrades_gracefully(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x")  # no shape
+        y = sd.op("tanh", x)
+        assert y.shape is None  # unknown, not wrong

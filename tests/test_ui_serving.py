@@ -127,3 +127,38 @@ def test_device_profiler_captures_xplane(tmp_path):
             jax.block_until_ready(jax.jit(lambda x: x @ x)(a))
     files = prof.trace_files()
     assert files, "no .xplane.pb produced"
+
+
+def test_ui_model_graph_tab():
+    """C14 model-graph tier: /model/graph serves the attached net topology."""
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.ui.server import UIServer, model_graph_json
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    g = model_graph_json(net)
+    assert [n["type"] for n in g["nodes"]] == ["Input", "DenseLayer", "OutputLayer"]
+    assert g["nodes"][1]["params"] == 4 * 8 + 8
+    assert len(g["edges"]) == 2
+
+    srv = UIServer(port=0)
+    try:
+        srv.attach_model(net)
+        port = srv.port
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/model/graph") as r:
+            got = json.loads(r.read())
+        assert got == g
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/train/model") as r:
+            html = r.read().decode()
+        assert "DenseLayer" in html and "Model graph" in html
+    finally:
+        srv.stop()
