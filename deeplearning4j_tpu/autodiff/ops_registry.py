@@ -30,10 +30,55 @@ def op(name: str):
     return deco
 
 
+# Platform-override hook (SURVEY §2.1 N10): per-op vendor/fast-path impls
+# consulted BEFORE the generic impl — the role of libnd4j's PlatformHelper
+# (cuDNN/oneDNN overrides checked at DeclarableOp::execute). Here the
+# predicate runs at trace time (backend identity is static under jit), so
+# choosing e.g. a Pallas kernel on TPU costs nothing at execution.
+PLATFORM_OVERRIDES: Dict[str, list] = {}
+OVERRIDES_VERSION = 0  # bumped on register/clear; trace caches key on it
+
+
+def overrides_version() -> int:
+    return OVERRIDES_VERSION
+
+
+def register_platform_override(op_name: str, predicate: Callable[[], bool],
+                               impl: Callable) -> None:
+    """Install ``impl`` for ``op_name`` whenever ``predicate()`` holds at
+    trace time (e.g. ``lambda: jax.default_backend() == 'tpu'``)."""
+    global OVERRIDES_VERSION
+    if op_name not in OPS:
+        raise KeyError(f"unknown op '{op_name}'")
+    PLATFORM_OVERRIDES.setdefault(op_name, []).append((predicate, impl))
+    OVERRIDES_VERSION += 1
+
+
+def clear_platform_overrides(op_name: str | None = None) -> None:
+    global OVERRIDES_VERSION
+    if op_name is None:
+        PLATFORM_OVERRIDES.clear()
+    else:
+        PLATFORM_OVERRIDES.pop(op_name, None)
+    OVERRIDES_VERSION += 1
+
+
 def get_op(name: str) -> Callable:
     if name not in OPS:
         raise KeyError(f"unknown op '{name}' (registry has {len(OPS)} ops)")
-    return OPS[name]
+    base = OPS[name]
+    overrides = PLATFORM_OVERRIDES.get(name)
+    if not overrides:
+        return base
+
+    def dispatch(*args, **kwargs):
+        for pred, impl in overrides:
+            if pred():
+                return impl(*args, **kwargs)
+        return base(*args, **kwargs)
+
+    dispatch.op_name = name
+    return dispatch
 
 
 # ------------------------------------------------------------- broadcastable

@@ -431,8 +431,13 @@ class SameDiff:
         if isinstance(outputs, str):
             outputs = [outputs]
         outputs = tuple(outputs)
+        from .ops_registry import overrides_version
+
         ph = {k: jnp.asarray(v) for k, v in (placeholders or {}).items()}
-        sig = (outputs, tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in ph.items())))
+        # overrides_version: platform overrides registered AFTER a trace was
+        # cached must invalidate it (the dispatch choice bakes in at trace)
+        sig = (outputs, overrides_version(),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in ph.items())))
         if sig not in self._fn_cache:
             self._fn_cache[sig] = jax.jit(self._trace_fn(outputs))
         var_arrays = {k: v for k, v in self.arrays.items()}

@@ -1,0 +1,102 @@
+"""Audio ETL (SURVEY §2.3 D6: ``datavec-data-audio``).
+
+Reference: ``org.datavec.audio.recordreader.WavFileRecordReader`` (raw
+waveform rows) and the FFT feature pipeline. Decode is stdlib ``wave`` (the
+reference uses its own WavFile reader — no external deps either way);
+spectrogram features are numpy STFT host-side, same division of labor as
+the image pipeline (ETL on host, training math on device).
+"""
+
+from __future__ import annotations
+
+import os
+import wave
+from typing import List, Optional
+
+import numpy as np
+
+from .records import InputSplit, RecordReader
+
+
+def read_wav(path: str) -> tuple:
+    """(samples float32 [-1, 1] mono, sample_rate)."""
+    with wave.open(path, "rb") as w:
+        n = w.getnframes()
+        raw = w.readframes(n)
+        width = w.getsampwidth()
+        channels = w.getnchannels()
+        rate = w.getframerate()
+    if width == 2:
+        x = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 1:
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    elif width == 4:
+        x = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported sample width {width} in {path}")
+    if channels > 1:
+        x = x.reshape(-1, channels).mean(axis=1)
+    return x, rate
+
+
+def spectrogram(x: np.ndarray, n_fft: int = 256, hop: int = 128) -> np.ndarray:
+    """Magnitude STFT [frames, n_fft//2+1] (Hann window)."""
+    if len(x) < n_fft:
+        x = np.pad(x, (0, n_fft - len(x)))
+    win = np.hanning(n_fft).astype(np.float32)
+    starts = range(0, len(x) - n_fft + 1, hop)
+    frames = np.stack([x[s:s + n_fft] * win for s in starts])
+    return np.abs(np.fft.rfft(frames, axis=-1)).astype(np.float32)
+
+
+class WavFileRecordReader(RecordReader):
+    """org.datavec.audio.recordreader.WavFileRecordReader: each record =
+    [features, label?]; features = raw waveform (default) or spectrogram;
+    dir-name labels via an optional label generator (image-reader parity)."""
+
+    def __init__(self, features: str = "waveform", n_fft: int = 256,
+                 hop: int = 128, max_samples: Optional[int] = None,
+                 label_generator=None):
+        if features not in ("waveform", "spectrogram"):
+            raise ValueError(f"features={features!r}: waveform|spectrogram")
+        self.features = features
+        self.n_fft = n_fft
+        self.hop = hop
+        self.max_samples = max_samples
+        self.label_gen = label_generator
+        self._files: List[str] = []
+        self._labels: List[str] = []
+        self._label_idx = {}
+        self._i = 0
+
+    def initialize(self, split: InputSplit) -> "WavFileRecordReader":
+        self._files = [f for f in split.locations() if f.lower().endswith(".wav")]
+        if self.label_gen is not None:
+            self._labels = sorted({self.label_gen.label_for_path(f)
+                                   for f in self._files})
+            self._label_idx = {l: i for i, l in enumerate(self._labels)}
+        self._i = 0
+        return self
+
+    def labels(self) -> List[str]:
+        return list(self._labels)
+
+    def has_next(self) -> bool:
+        return self._i < len(self._files)
+
+    def reset(self):
+        self._i = 0
+
+    def next(self) -> List:
+        path = self._files[self._i]
+        self._i += 1
+        x, _rate = read_wav(path)
+        if self.max_samples:
+            x = x[: self.max_samples]
+            if len(x) < self.max_samples:
+                x = np.pad(x, (0, self.max_samples - len(x)))
+        feat = (spectrogram(x, self.n_fft, self.hop)
+                if self.features == "spectrogram" else x)
+        if self.label_gen is None:
+            return [feat]
+        return [feat, self._label_idx[self.label_gen.label_for_path(path)]]

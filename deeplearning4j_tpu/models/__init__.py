@@ -23,10 +23,13 @@ from .vgg import VGG16
 from .text_lstm import TextGenerationLSTM
 from .zoo_ext import AlexNet, Darknet19, SqueezeNet, UNet, Xception
 from .moe import MoEConfig, init_moe_params, moe_ffn, moe_partition_specs
+from .vae import VariationalAutoencoder
+from .yolo import TinyYOLO, Yolo2OutputLayer
 
 __all__ = [
     "AlexNet", "Darknet19", "SqueezeNet", "UNet", "Xception",
     "MoEConfig", "init_moe_params", "moe_ffn", "moe_partition_specs",
+    "VariationalAutoencoder", "TinyYOLO", "Yolo2OutputLayer",
     "TransformerConfig",
     "transformer_forward",
     "transformer_init",

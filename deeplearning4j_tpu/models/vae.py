@@ -1,0 +1,177 @@
+"""Variational autoencoder (SURVEY §2.4 C4/C16).
+
+Reference: ``org.deeplearning4j.nn.layers.variational.VariationalAutoencoder``
+— encoder → (mean, log-variance) → reparameterized sample → decoder, trained
+unsupervised on the ELBO; ``reconstructionProbability`` estimates p(x) by
+importance sampling; Bernoulli or Gaussian reconstruction distributions.
+
+TPU-native: one jitted train step (encoder+sampler+decoder+ELBO+Adam), a
+jitted importance-sampling estimator (samples vmapped on-device).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.updaters import Adam
+
+
+def _mlp_init(key, sizes: Sequence[int], dtype=jnp.float32):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (a, b) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        params.append({
+            "W": (jax.random.normal(k, (a, b)) * np.sqrt(2.0 / a)).astype(dtype),
+            "b": jnp.zeros(b, dtype),
+        })
+    return params
+
+
+def _mlp(params, x, act=jax.nn.relu, last_act=None):
+    for i, layer in enumerate(params):
+        x = x @ layer["W"] + layer["b"]
+        if i < len(params) - 1:
+            x = act(x)
+        elif last_act is not None:
+            x = last_act(x)
+    return x
+
+
+class VariationalAutoencoder:
+    """VariationalAutoencoder capability surface as a standalone model."""
+
+    def __init__(self, n_in: int, latent: int = 8,
+                 encoder_layers: Sequence[int] = (64,),
+                 decoder_layers: Sequence[int] = (64,),
+                 reconstruction: str = "bernoulli",  # bernoulli | gaussian
+                 learning_rate: float = 1e-3, seed: int = 42):
+        if reconstruction not in ("bernoulli", "gaussian"):
+            raise ValueError(reconstruction)
+        self.n_in = n_in
+        self.latent = latent
+        self.reconstruction = reconstruction
+        self.seed = seed
+        k1, k2 = jax.random.split(jax.random.key(seed))
+        enc_sizes = [n_in, *encoder_layers, 2 * latent]          # mu ++ logvar
+        out_mult = 2 if reconstruction == "gaussian" else 1
+        dec_sizes = [latent, *decoder_layers, out_mult * n_in]
+        self.params = {"enc": _mlp_init(k1, enc_sizes),
+                       "dec": _mlp_init(k2, dec_sizes)}
+        self.updater = Adam(learning_rate)
+        self.opt_state = self.updater.init(self.params)
+        self.iteration = 0
+        self.loss_curve: List[float] = []
+
+    # ------------------------------------------------------------ internals
+
+    def _encode(self, params, x):
+        h = _mlp(params["enc"], x)
+        return h[:, : self.latent], h[:, self.latent:]
+
+    def _decode(self, params, z):
+        out = _mlp(params["dec"], z)
+        if self.reconstruction == "gaussian":
+            return out[:, : self.n_in], out[:, self.n_in:]
+        return jax.nn.sigmoid(out), None
+
+    def _recon_loglik(self, x, mean, logvar2):
+        if self.reconstruction == "bernoulli":
+            p = jnp.clip(mean, 1e-7, 1 - 1e-7)
+            return jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
+        return jnp.sum(-0.5 * (jnp.log(2 * jnp.pi) + logvar2
+                               + jnp.square(x - mean) / jnp.exp(logvar2)), axis=-1)
+
+    def _elbo(self, params, x, rng):
+        mu, logvar = self._encode(params, x)
+        eps = jax.random.normal(rng, mu.shape)
+        z = mu + jnp.exp(0.5 * logvar) * eps
+        mean, lv2 = self._decode(params, z)
+        recon = self._recon_loglik(x, mean, lv2)
+        kl = 0.5 * jnp.sum(jnp.exp(logvar) + jnp.square(mu) - 1.0 - logvar, axis=-1)
+        return jnp.mean(kl - recon)  # negative ELBO
+
+    def _step_fn(self):
+        if not hasattr(self, "_jitted_step"):
+            updater = self.updater
+
+            @jax.jit
+            def step(params, opt, x, it, rng):
+                loss, grads = jax.value_and_grad(self._elbo)(params, x, rng)
+                updates, new_opt = updater.apply(grads, opt, params, it, 0)
+                new_params = jax.tree.map(lambda p, u: p - u, params, updates)
+                return new_params, new_opt, loss
+
+            self._jitted_step = step
+        return self._jitted_step
+
+    # ------------------------------------------------------------ public API
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 128) -> "VariationalAutoencoder":
+        """Unsupervised ELBO training (the reference's pretrain phase)."""
+        x = np.asarray(data, np.float32)
+        if len(x) == 0:
+            raise ValueError("empty dataset")
+        batch_size = min(batch_size, len(x))
+        step = self._step_fn()
+        rs = np.random.RandomState(self.seed)
+        loss = jnp.nan
+        for _ in range(epochs):
+            order = rs.permutation(len(x))
+            for off in range(0, len(x) - batch_size + 1, batch_size):
+                xb = jnp.asarray(x[order[off:off + batch_size]])
+                rng = jax.random.fold_in(jax.random.key(self.seed ^ 0xE1B0),
+                                         self.iteration)
+                self.params, self.opt_state, loss = step(
+                    self.params, self.opt_state, xb,
+                    jnp.asarray(self.iteration, jnp.int32), rng)
+                self.iteration += 1
+            self.loss_curve.append(float(loss))
+        return self
+
+    def activate(self, x) -> np.ndarray:
+        """Latent means (the layer's feed-forward activation)."""
+        mu, _ = self._encode(self.params, jnp.asarray(np.asarray(x, np.float32)))
+        return np.asarray(mu)
+
+    def reconstruct(self, x) -> np.ndarray:
+        mu, _ = self._encode(self.params, jnp.asarray(np.asarray(x, np.float32)))
+        mean, _ = self._decode(self.params, mu)
+        return np.asarray(mean)
+
+    def generate(self, z) -> np.ndarray:
+        """Decode latent codes (generateAtMeanGivenZ)."""
+        mean, _ = self._decode(self.params, jnp.asarray(np.asarray(z, np.float32)))
+        return np.asarray(mean)
+
+    def reconstruction_probability(self, x, num_samples: int = 16) -> np.ndarray:
+        """log p(x) importance-sampling estimate
+        (VariationalAutoencoder.reconstructionLogProbability)."""
+        xj = jnp.asarray(np.asarray(x, np.float32))
+
+        @jax.jit
+        def est(params, x, rng):
+            mu, logvar = self._encode(params, x)
+
+            def one(key):
+                eps = jax.random.normal(key, mu.shape)
+                z = mu + jnp.exp(0.5 * logvar) * eps
+                mean, lv2 = self._decode(params, z)
+                recon = self._recon_loglik(x, mean, lv2)
+                # log w = log p(x|z) + log p(z) - log q(z|x)
+                logp_z = jnp.sum(-0.5 * (jnp.log(2 * jnp.pi) + jnp.square(z)), -1)
+                logq = jnp.sum(-0.5 * (jnp.log(2 * jnp.pi) + logvar
+                                       + jnp.square(eps)), -1)
+                return recon + logp_z - logq
+
+            keys = jax.random.split(rng, num_samples)
+            logw = jax.vmap(one)(keys)                        # [S, B]
+            return jax.nn.logsumexp(logw, axis=0) - jnp.log(num_samples)
+
+        return np.asarray(est(self.params, xj, jax.random.key(self.seed ^ 0x1517)))
+
+    reconstructionLogProbability = reconstruction_probability

@@ -123,7 +123,11 @@ def model_graph_json(net) -> dict:
     """Topology descriptor for the model tab (VertxUIServer's model-graph
     FlatBuffers → plain JSON): nodes with layer class + param counts, edges
     from the config wiring."""
+    import jax
     import numpy as np
+
+    def n_params(p):
+        return int(sum(np.prod(np.shape(l)) for l in jax.tree.leaves(p)))
 
     nodes, edges = [], []
     conf = net.conf
@@ -134,8 +138,7 @@ def model_graph_json(net) -> dict:
             kind = (type(node.layer).__name__ if node.layer is not None
                     else type(node.vertex).__name__)
             p = net.params_.get(name, {})
-            nodes.append({"name": name, "type": kind,
-                          "params": int(sum(np.prod(w.shape) for w in p.values()))})
+            nodes.append({"name": name, "type": kind, "params": n_params(p)})
             for src in node.inputs:
                 edges.append([src, name])
     else:  # MultiLayerNetwork
@@ -145,7 +148,7 @@ def model_graph_json(net) -> dict:
             name = f"{i}:{type(layer).__name__}"
             p = net.params_.get(str(i), {})
             nodes.append({"name": name, "type": type(layer).__name__,
-                          "params": int(sum(np.prod(w.shape) for w in p.values()))})
+                          "params": n_params(p)})
             edges.append([prev, name])
             prev = name
     return {"nodes": nodes, "edges": edges}

@@ -239,3 +239,78 @@ class TestShapeFnContract:
         x = sd.placeholder("x")  # no shape
         y = sd.op("tanh", x)
         assert y.shape is None  # unknown, not wrong
+
+
+class TestPlatformOverrides:
+    """N10 platform-helper hook: fast-path impls consulted before generic
+    (the cuDNN/oneDNN PlatformHelper pattern, generalized to any op)."""
+
+    def test_override_dispatch_and_clear(self):
+        from deeplearning4j_tpu.autodiff.ops_registry import (
+            clear_platform_overrides,
+            get_op,
+            register_platform_override,
+        )
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        calls = []
+
+        def fast_tanh(x):
+            calls.append(1)
+            return np.tanh(np.asarray(x)) * 1.0
+
+        try:
+            register_platform_override("tanh", lambda: True, fast_tanh)
+            out = get_op("tanh")(np.float32(0.5))
+            assert calls, "override not consulted"
+            np.testing.assert_allclose(np.asarray(out), np.tanh(0.5), rtol=1e-6)
+
+            # predicate False → generic path
+            clear_platform_overrides("tanh")
+            register_platform_override("tanh", lambda: False, fast_tanh)
+            calls.clear()
+            get_op("tanh")(np.float32(0.5))
+            assert not calls
+        finally:
+            clear_platform_overrides("tanh")
+
+    def test_override_flows_through_samediff(self):
+        from deeplearning4j_tpu.autodiff.ops_registry import (
+            clear_platform_overrides,
+            register_platform_override,
+        )
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        import jax.numpy as jnp
+
+        try:
+            register_platform_override("relu", lambda: True,
+                                       lambda x: jnp.maximum(x, 0.0) + 1.0)
+            sd = SameDiff.create()
+            x = sd.placeholder("x", (3,))
+            y = sd.op("relu", x, name="y")
+            got = sd.output({"x": np.array([-1.0, 0.5, 2.0], np.float32)}, "y")["y"]
+            np.testing.assert_allclose(np.asarray(got), [1.0, 1.5, 3.0])
+        finally:
+            clear_platform_overrides("relu")
+
+    def test_override_registered_after_trace_invalidates_cache(self):
+        from deeplearning4j_tpu.autodiff.ops_registry import (
+            clear_platform_overrides,
+            register_platform_override,
+        )
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        import jax.numpy as jnp
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2,))
+        sd.op("relu", x, name="y")
+        feed = {"x": np.array([-1.0, 2.0], np.float32)}
+        base = sd.output(feed, "y")["y"]  # trace + cache with generic impl
+        np.testing.assert_allclose(np.asarray(base), [0.0, 2.0])
+        try:
+            register_platform_override("relu", lambda: True,
+                                       lambda v: jnp.maximum(v, 0.0) + 5.0)
+            got = sd.output(feed, "y")["y"]
+            np.testing.assert_allclose(np.asarray(got), [5.0, 7.0])
+        finally:
+            clear_platform_overrides("relu")

@@ -140,3 +140,61 @@ def test_cifar_emnist_tinyimagenet_iterators():
         assert it.has_next()
         # train/test disjoint determinism
         assert it.synthetic
+
+
+def test_wav_record_reader_and_spectrogram(tmp_path):
+    """D6 audio: WAV decode (stdlib wave) → waveform/spectrogram rows with
+    dir labels."""
+    import wave as wavmod
+
+    from deeplearning4j_tpu.data.audio import WavFileRecordReader, read_wav, spectrogram
+    from deeplearning4j_tpu.data.image import ParentPathLabelGenerator
+    from deeplearning4j_tpu.data.records import FileSplit
+
+    rs = np.random.RandomState(0)
+    for ci, cls in enumerate(["sine", "noise"]):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(2):
+            t = np.arange(2000) / 8000.0
+            x = (np.sin(2 * np.pi * 440 * t) if cls == "sine"
+                 else rs.randn(2000) * 0.3)
+            pcm = (np.clip(x, -1, 1) * 32767).astype(np.int16)
+            with wavmod.open(str(d / f"a{i}.wav"), "wb") as w:
+                w.setnchannels(1); w.setsampwidth(2); w.setframerate(8000)
+                w.writeframes(pcm.tobytes())
+
+    x, rate = read_wav(str(tmp_path / "sine" / "a0.wav"))
+    assert rate == 8000 and abs(float(np.max(x)) - 1.0) < 0.01
+
+    rr = WavFileRecordReader(features="spectrogram", n_fft=128, hop=64,
+                             max_samples=2000,
+                             label_generator=ParentPathLabelGenerator())
+    rr.initialize(FileSplit(str(tmp_path)))
+    assert rr.labels() == ["noise", "sine"]
+    rows = []
+    while rr.has_next():
+        rows.append(rr.next())
+    assert len(rows) == 4
+    feat, label = rows[0]
+    assert feat.shape[1] == 128 // 2 + 1
+    # a pure sine concentrates energy in one bin; noise doesn't
+    sine_rows = [r for r in rows if r[1] == rr.labels().index("sine")]
+    spec = sine_rows[0][0].mean(0)
+    assert spec.argmax() == round(440 * 128 / 8000)
+
+
+def test_tfidf_vectorizer():
+    """D6 NLP: TfidfVectorizer fit/transform parity behaviors."""
+    from deeplearning4j_tpu.nlp.tfidf import TfidfVectorizer
+
+    corpus = ["the cat sat", "the dog sat", "the cat ran fast"]
+    v = TfidfVectorizer(normalize=True)
+    m = v.fit_transform(corpus)
+    assert m.shape == (3, len(v.vocab_))
+    np.testing.assert_allclose(np.linalg.norm(m, axis=1), 1.0, rtol=1e-5)
+    # 'the' appears everywhere → lowest idf; 'fast' in one doc → highest
+    assert v.idf_[v.vocab_["the"]] < v.idf_[v.vocab_["fast"]]
+    # unseen words ignored at transform
+    m2 = v.transform(["zebra cat"])
+    assert m2[0, v.vocab_["cat"]] > 0
