@@ -516,7 +516,9 @@ class SameDiff:
         if not self.updater_state:
             self.updater_state = cfg.updater.init(
                 {n: self.arrays[n] for n in self._trainable()})
-        key = ("__train__", tuple(self.loss_names))
+        from .ops_registry import overrides_version
+
+        key = ("__train__", overrides_version(), tuple(self.loss_names))
         if key not in self._fn_cache:
             self._fn_cache[key] = self._train_step()
         step, trainable = self._fn_cache[key]

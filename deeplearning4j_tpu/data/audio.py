@@ -15,7 +15,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .records import InputSplit, RecordReader
+from .records import InputSplit, LabeledFileRecordReader, RecordReader
 
 
 def read_wav(path: str) -> tuple:
@@ -49,47 +49,26 @@ def spectrogram(x: np.ndarray, n_fft: int = 256, hop: int = 128) -> np.ndarray:
     return np.abs(np.fft.rfft(frames, axis=-1)).astype(np.float32)
 
 
-class WavFileRecordReader(RecordReader):
+class WavFileRecordReader(LabeledFileRecordReader):
     """org.datavec.audio.recordreader.WavFileRecordReader: each record =
     [features, label?]; features = raw waveform (default) or spectrogram;
     dir-name labels via an optional label generator (image-reader parity)."""
+
+    _extensions = (".wav",)
 
     def __init__(self, features: str = "waveform", n_fft: int = 256,
                  hop: int = 128, max_samples: Optional[int] = None,
                  label_generator=None):
         if features not in ("waveform", "spectrogram"):
             raise ValueError(f"features={features!r}: waveform|spectrogram")
+        super().__init__(label_generator)
         self.features = features
         self.n_fft = n_fft
         self.hop = hop
         self.max_samples = max_samples
-        self.label_gen = label_generator
-        self._files: List[str] = []
-        self._labels: List[str] = []
-        self._label_idx = {}
-        self._i = 0
 
-    def initialize(self, split: InputSplit) -> "WavFileRecordReader":
-        self._files = [f for f in split.locations() if f.lower().endswith(".wav")]
-        if self.label_gen is not None:
-            self._labels = sorted({self.label_gen.label_for_path(f)
-                                   for f in self._files})
-            self._label_idx = {l: i for i, l in enumerate(self._labels)}
-        self._i = 0
-        return self
-
-    def labels(self) -> List[str]:
-        return list(self._labels)
-
-    def has_next(self) -> bool:
-        return self._i < len(self._files)
-
-    def reset(self):
-        self._i = 0
-
-    def next(self) -> List:
-        path = self._files[self._i]
-        self._i += 1
+    def read_index(self, idx: int) -> List:
+        path = self._files[idx]
         x, _rate = read_wav(path)
         if self.max_samples:
             x = x[: self.max_samples]
@@ -99,4 +78,4 @@ class WavFileRecordReader(RecordReader):
                 if self.features == "spectrogram" else x)
         if self.label_gen is None:
             return [feat]
-        return [feat, self._label_idx[self.label_gen.label_for_path(path)]]
+        return [feat, self._label_of(path)]

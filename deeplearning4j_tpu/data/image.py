@@ -26,7 +26,7 @@ import numpy as np
 
 from .dataset import DataSet
 from .iterators import DataSetIterator
-from .records import InputSplit, RecordReader
+from .records import InputSplit, LabeledFileRecordReader, RecordReader
 
 _IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
 
@@ -166,7 +166,7 @@ class PipelineImageTransform(ImageTransform):
 # ------------------------------------------------------------------ reader
 
 
-class ImageRecordReader(RecordReader):
+class ImageRecordReader(LabeledFileRecordReader):
     """org.datavec.image.recordreader.ImageRecordReader: decode → (optional
     transform chain) → resize to (height, width) → CHW float32 + label index.
 
@@ -175,43 +175,15 @@ class ImageRecordReader(RecordReader):
     to batch into DataSets.
     """
 
+    _extensions = _IMG_EXTS
+
     def __init__(self, height: int, width: int, channels: int = 3,
                  label_generator: Optional[PathLabelGenerator] = None,
                  transform: Optional[ImageTransform] = None, seed: int = 123):
+        super().__init__(label_generator)
         self.height, self.width, self.channels = height, width, channels
-        self.label_gen = label_generator
         self.transform = transform
         self.seed = seed
-        self._files: List[str] = []
-        self._labels: List[str] = []
-        self._label_idx: dict = {}
-        self._i = 0
-
-    def initialize(self, split: InputSplit) -> "ImageRecordReader":
-        self._files = [f for f in split.locations()
-                       if f.lower().endswith(_IMG_EXTS)]
-        if self.label_gen is not None:
-            self._labels = sorted({self.label_gen.label_for_path(f) for f in self._files})
-            self._label_idx = {l: i for i, l in enumerate(self._labels)}
-        self._i = 0
-        return self
-
-    def labels(self) -> List[str]:
-        return list(self._labels)
-
-    def num_labels(self) -> int:
-        return len(self._labels)
-
-    def has_next(self) -> bool:
-        return self._i < len(self._files)
-
-    def reset(self):
-        self._i = 0
-
-    def next(self) -> List:
-        idx = self._i
-        self._i += 1
-        return self.read_index(idx)
 
     def read_index(self, idx: int) -> List:
         """Decode + augment file #idx. Augmentation rng is seeded per image
@@ -225,14 +197,7 @@ class ImageRecordReader(RecordReader):
         img = self._to_chw(img)
         if self.label_gen is None:
             return [img]
-        return [img, self._label_idx[self.label_gen.label_for_path(path)]]
-
-    def take_indices(self, n: int) -> List[int]:
-        """Claim the next n file indices (for batched parallel decode)."""
-        start = self._i
-        end = min(start + n, len(self._files))
-        self._i = end
-        return list(range(start, end))
+        return [img, self._label_of(path)]
 
     # -- decode helpers (NativeImageLoader.asMatrix equivalents) ------------
 

@@ -171,3 +171,59 @@ def load_csv_f32(path: str, delimiter: str = ",", skip_rows: int = 0):
                           dtype=np.float32, ndmin=2)
     except ValueError:
         return None
+
+
+class LabeledFileRecordReader(RecordReader):
+    """Shared scaffolding for file-per-example readers with directory-derived
+    labels (image/audio): split filtering, sorted label index, sequential or
+    index-addressed reads. Subclasses set ``_extensions`` and implement
+    ``read_index``."""
+
+    _extensions: tuple = ()
+
+    def __init__(self, label_generator=None):
+        self.label_gen = label_generator
+        self._files: List[str] = []
+        self._labels: List[str] = []
+        self._label_idx: dict = {}
+        self._i = 0
+
+    def initialize(self, split: InputSplit):
+        self._files = [f for f in split.locations()
+                       if f.lower().endswith(self._extensions)]
+        if self.label_gen is not None:
+            self._labels = sorted({self.label_gen.label_for_path(f)
+                                   for f in self._files})
+            self._label_idx = {l: i for i, l in enumerate(self._labels)}
+        self._i = 0
+        return self
+
+    def labels(self) -> List[str]:
+        return list(self._labels)
+
+    def num_labels(self) -> int:
+        return len(self._labels)
+
+    def has_next(self) -> bool:
+        return self._i < len(self._files)
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def next(self) -> List:
+        idx = self._i
+        self._i += 1
+        return self.read_index(idx)
+
+    def take_indices(self, n: int) -> List[int]:
+        """Claim the next n file indices (for batched parallel decode)."""
+        start = self._i
+        end = min(start + n, len(self._files))
+        self._i = end
+        return list(range(start, end))
+
+    def _label_of(self, path: str) -> int:
+        return self._label_idx[self.label_gen.label_for_path(path)]
+
+    def read_index(self, idx: int) -> List:
+        raise NotImplementedError

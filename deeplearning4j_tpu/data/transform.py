@@ -329,6 +329,26 @@ class TransformProcess:
 
         convertToDouble = convert_to_double
 
+        def string_to_time(self, name, fmt="%Y-%m-%d %H:%M:%S"):
+            self._steps.append(_StringToTime(name, fmt))
+            return self
+
+        stringToTimeTransform = string_to_time
+
+        def derive_time_fields(self, name, *fields):
+            self._steps.append(_DeriveTimeFields(name, fields or ("hourOfDay", "dayOfWeek")))
+            return self
+
+        def conditional_replace(self, name, cond_op, cond_value, replacement):
+            self._steps.append(_ConditionalReplace(name, cond_op, cond_value, replacement))
+            return self
+
+        conditionalReplaceValueTransform = conditional_replace
+
+        def filter_by_condition(self, name, cond_op, cond_value):
+            self._steps.append(_FilterByCondition(name, cond_op, cond_value))
+            return self
+
         def build(self) -> "TransformProcess":
             return TransformProcess(self._schema, list(self._steps))
 
@@ -360,3 +380,198 @@ class TransformProcess:
             Schema(d["initial_schema"]["columns"]),
             [_Step.from_json(sd) for sd in d["steps"]],
         )
+
+
+# ------------------------------------------------------- D2 breadth (wave 2)
+
+
+@_step("string_to_time")
+class _StringToTime(_Step):
+    """org.datavec transform.time.StringToTimeTransform: parse a string
+    column into epoch milliseconds (LongColumn)."""
+
+    def __init__(self, name, fmt="%Y-%m-%d %H:%M:%S"):
+        self.name = name
+        self.fmt = fmt
+
+    def apply_schema(self, schema):
+        cols = [dict(c) for c in schema.columns]
+        cols[schema.index_of(self.name)]["type"] = ColumnType.LONG
+        return Schema(cols)
+
+    def apply(self, rows, schema):
+        import datetime as _dt
+
+        i = schema.index_of(self.name)
+        out = []
+        for r in rows:
+            r = list(r)
+            t = _dt.datetime.strptime(str(r[i]), self.fmt)
+            r[i] = int(t.replace(tzinfo=_dt.timezone.utc).timestamp() * 1000)
+            out.append(r)
+        return out
+
+
+@_step("derive_time_fields")
+class _DeriveTimeFields(_Step):
+    """transform.time.DeriveColumnsFromTimeTransform: append hour-of-day /
+    day-of-week integer columns from an epoch-ms column."""
+
+    def __init__(self, name, fields=("hourOfDay", "dayOfWeek")):
+        self.name = name
+        self.fields = list(fields)
+
+    def apply_schema(self, schema):
+        cols = [dict(c) for c in schema.columns]
+        for f in self.fields:
+            cols.append({"name": f"{self.name}_{f}", "type": ColumnType.INTEGER})
+        return Schema(cols)
+
+    def apply(self, rows, schema):
+        import datetime as _dt
+
+        i = schema.index_of(self.name)
+        out = []
+        for r in rows:
+            t = _dt.datetime.fromtimestamp(int(r[i]) / 1000.0, _dt.timezone.utc)
+            extra = []
+            for f in self.fields:
+                if f == "hourOfDay":
+                    extra.append(t.hour)
+                elif f == "dayOfWeek":
+                    extra.append(t.weekday())
+                elif f == "monthOfYear":
+                    extra.append(t.month)
+                else:
+                    raise ValueError(f"unknown time field {f}")
+            out.append(list(r) + extra)
+        return out
+
+
+@_step("conditional_replace")
+class _ConditionalReplace(_Step):
+    """transform.condition ConditionalReplaceValueTransform: replace a
+    column's value where a (column, op, value) condition holds."""
+
+    _OPS = {"lt": lambda a, b: a < b, "lte": lambda a, b: a <= b,
+            "gt": lambda a, b: a > b, "gte": lambda a, b: a >= b,
+            "eq": lambda a, b: a == b, "neq": lambda a, b: a != b}
+
+    def __init__(self, name, cond_op, cond_value, replacement):
+        self.name = name
+        self.cond_op = cond_op
+        self.cond_value = cond_value
+        self.replacement = replacement
+
+    @staticmethod
+    def _holds(op_name, value, cond_value):
+        """Numeric compare when both sides parse; eq/neq fall back to string
+        equality; ORDERING ops on unparseable values are False (lexicographic
+        ordering of numeric-typed strings gives wrong answers silently)."""
+        op = _ConditionalReplace._OPS[op_name]
+        try:
+            return op(float(value), float(cond_value))
+        except (TypeError, ValueError):
+            if op_name in ("eq", "neq"):
+                return op(str(value), str(cond_value))
+            return False
+
+    def apply(self, rows, schema):
+        i = schema.index_of(self.name)
+        out = []
+        for r in rows:
+            r = list(r)
+            if self._holds(self.cond_op, r[i], self.cond_value):
+                r[i] = self.replacement
+            out.append(r)
+        return out
+
+
+@_step("filter_by_condition")
+class _FilterByCondition(_Step):
+    """transform.filter.ConditionFilter: DROP rows where the condition holds."""
+
+    def __init__(self, name, cond_op, cond_value):
+        self.name = name
+        self.cond_op = cond_op
+        self.cond_value = cond_value
+
+    def apply(self, rows, schema):
+        i = schema.index_of(self.name)
+        return [r for r in rows
+                if not _ConditionalReplace._holds(self.cond_op, r[i],
+                                                  self.cond_value)]
+
+
+def join(left_schema: Schema, left_rows, right_schema: Schema, right_rows,
+         key: str, join_type: str = "Inner"):
+    """org.datavec.api.transform.join.Join (Inner/LeftOuter): returns
+    (schema, rows) with the right side's non-key columns appended."""
+    if join_type not in ("Inner", "LeftOuter"):
+        raise ValueError(join_type)
+    li = left_schema.index_of(key)
+    ri = right_schema.index_of(key)
+    rcols = [c for j, c in enumerate(right_schema.columns) if j != ri]
+    out_schema = Schema([dict(c) for c in left_schema.columns]
+                        + [dict(c) for c in rcols])
+    index: Dict[Any, List] = {}
+    for r in right_rows:
+        index.setdefault(r[ri], []).append(
+            [v for j, v in enumerate(r) if j != ri])
+    rows = []
+    pad = [None] * len(rcols)
+    for l in left_rows:
+        matches = index.get(l[li])
+        if matches:
+            for m in matches:
+                rows.append(list(l) + m)
+        elif join_type == "LeftOuter":
+            rows.append(list(l) + pad)
+    return out_schema, rows
+
+
+class DataAnalysis:
+    """org.datavec.api.transform.analysis.DataAnalysis (AnalyzeLocal):
+    per-column stats over (schema, rows)."""
+
+    def __init__(self, schema: Schema, column_stats: Dict[str, Dict[str, Any]]):
+        self.schema = schema
+        self.column_stats = column_stats
+
+    @staticmethod
+    def analyze(schema: Schema, rows) -> "DataAnalysis":
+        import numpy as _np
+
+        stats: Dict[str, Dict[str, Any]] = {}
+        for j, col in enumerate(schema.columns):
+            vals = [r[j] for r in rows]
+            if col["type"] in (ColumnType.INTEGER, ColumnType.DOUBLE,
+                               ColumnType.LONG):
+                parsed = []
+                for v in vals:
+                    try:
+                        parsed.append(float(v))
+                    except (TypeError, ValueError):
+                        pass  # unparseable numeric → counted as missing
+                arr = _np.asarray(parsed, _np.float64)
+                stats[col["name"]] = {
+                    "count": int(arr.size),
+                    "min": float(arr.min()) if arr.size else None,
+                    "max": float(arr.max()) if arr.size else None,
+                    "mean": float(arr.mean()) if arr.size else None,
+                    "std": float(arr.std()) if arr.size else None,
+                    "countMissing": len(vals) - int(arr.size),
+                }
+            else:
+                uniq: Dict[str, int] = {}
+                for v in vals:
+                    uniq[str(v)] = uniq.get(str(v), 0) + 1
+                stats[col["name"]] = {
+                    "count": len(vals),
+                    "countUnique": len(uniq),
+                    "topByCount": sorted(uniq, key=uniq.get, reverse=True)[:5],
+                }
+        return DataAnalysis(schema, stats)
+
+    def to_json(self) -> str:
+        return json.dumps({"columns": self.column_stats})

@@ -152,6 +152,15 @@ class VariationalAutoencoder:
         """log p(x) importance-sampling estimate
         (VariationalAutoencoder.reconstructionLogProbability)."""
         xj = jnp.asarray(np.asarray(x, np.float32))
+        est = self._estimator(num_samples)
+        return np.asarray(est(self.params, xj, jax.random.key(self.seed ^ 0x1517)))
+
+    def _estimator(self, num_samples: int):
+        """jit-cached per num_samples (a fresh closure per call would
+        recompile every invocation)."""
+        cache = self.__dict__.setdefault("_est_cache", {})
+        if num_samples in cache:
+            return cache[num_samples]
 
         @jax.jit
         def est(params, x, rng):
@@ -172,6 +181,7 @@ class VariationalAutoencoder:
             logw = jax.vmap(one)(keys)                        # [S, B]
             return jax.nn.logsumexp(logw, axis=0) - jnp.log(num_samples)
 
-        return np.asarray(est(self.params, xj, jax.random.key(self.seed ^ 0x1517)))
+        cache[num_samples] = est
+        return est
 
     reconstructionLogProbability = reconstruction_probability

@@ -198,3 +198,56 @@ def test_tfidf_vectorizer():
     # unseen words ignored at transform
     m2 = v.transform(["zebra cat"])
     assert m2[0, v.vocab_["cat"]] > 0
+
+
+def test_transform_wave2_time_condition_join_analysis():
+    """D2 breadth: time parse/derive, conditional replace/filter, join,
+    DataAnalysis — all JSON round-trippable where step-based."""
+    from deeplearning4j_tpu.data.transform import (
+        DataAnalysis,
+        Schema,
+        TransformProcess,
+        join,
+    )
+
+    schema = (Schema.Builder()
+              .add_column_string("ts")
+              .add_column_double("amount")
+              .add_column_string("user")
+              .build())
+    tp = (TransformProcess.Builder(schema)
+          .string_to_time("ts")
+          .derive_time_fields("ts", "hourOfDay", "dayOfWeek")
+          .conditional_replace("amount", "lt", 0.0, 0.0)
+          .filter_by_condition("amount", "gt", 100.0)
+          .build())
+    rows = [
+        ["2024-03-04 13:30:00", -5.0, "a"],   # negative → clamped to 0
+        ["2024-03-05 07:00:00", 50.0, "b"],
+        ["2024-03-06 09:00:00", 500.0, "c"],  # filtered out (>100)
+    ]
+    out_schema = tp.final_schema()
+    assert [c["name"] for c in out_schema.columns][-2:] == ["ts_hourOfDay", "ts_dayOfWeek"]
+    out = tp.execute(rows)
+    assert len(out) == 2
+    assert out[0][1] == 0.0
+    assert out[0][-2] == 13 and out[0][-1] == 0  # 2024-03-04 = Monday
+    # JSON round trip executes identically
+    tp2 = TransformProcess.from_json(tp.to_json())
+    assert tp2.execute(rows) == out
+
+    # join
+    right = (Schema.Builder().add_column_string("user")
+             .add_column_integer("age").build())
+    js, jrows = join(out_schema, out, right, [["a", 30], ["x", 99]], "user",
+                     join_type="LeftOuter")
+    assert [c["name"] for c in js.columns][-1] == "age"
+    assert jrows[0][-1] == 30 and jrows[1][-1] is None
+    _, inner = join(out_schema, out, right, [["a", 30]], "user")
+    assert len(inner) == 1
+
+    # analysis
+    an = DataAnalysis.analyze(out_schema, out)
+    assert an.column_stats["amount"]["max"] == 50.0
+    assert an.column_stats["user"]["countUnique"] == 2
+    assert "mean" in an.column_stats["ts_hourOfDay"]
