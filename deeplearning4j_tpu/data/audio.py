@@ -15,7 +15,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .records import InputSplit, LabeledFileRecordReader, RecordReader
+from .records import InputSplit, LabeledFileRecordReader
 
 
 def read_wav(path: str) -> tuple:

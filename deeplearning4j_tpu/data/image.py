@@ -26,7 +26,7 @@ import numpy as np
 
 from .dataset import DataSet
 from .iterators import DataSetIterator
-from .records import InputSplit, LabeledFileRecordReader, RecordReader
+from .records import InputSplit, LabeledFileRecordReader
 
 _IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
 

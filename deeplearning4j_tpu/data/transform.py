@@ -512,6 +512,11 @@ def join(left_schema: Schema, left_rows, right_schema: Schema, right_rows,
     li = left_schema.index_of(key)
     ri = right_schema.index_of(key)
     rcols = [c for j, c in enumerate(right_schema.columns) if j != ri]
+    clash = {c["name"] for c in left_schema.columns} & {c["name"] for c in rcols}
+    if clash:
+        raise ValueError(
+            f"join would duplicate column names {sorted(clash)} — rename one "
+            "side first (Schema.index_of resolves the first match silently)")
     out_schema = Schema([dict(c) for c in left_schema.columns]
                         + [dict(c) for c in rcols])
     index: Dict[Any, List] = {}

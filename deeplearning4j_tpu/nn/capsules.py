@@ -1,0 +1,160 @@
+"""Capsule network layers (SURVEY §2.4 C4/C16 CapsNet).
+
+Reference: ``org.deeplearning4j.nn.conf.layers.{PrimaryCapsules,
+CapsuleLayer, CapsuleStrengthLayer}`` (implemented there as SameDiff layers
+with dynamic routing; Sabour et al. 2017).
+
+TPU-native: routing is three unrolled iterations of dense einsum algebra
+(prediction vectors einsum, softmax coupling, squash) — everything batches
+onto the MXU; no per-capsule loops.
+
+Layout convention matches the framework's recurrent tensors: capsule sets
+travel as [B, caps_dim, n_caps] (dim plays the channel role), so the layers
+compose with InputType.recurrent plumbing and GlobalPooling etc.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .conf import InputType, Layer
+from .weights import init_weights
+
+
+def squash(s, axis=-1, eps=1e-8):
+    """v = (|s|²/(1+|s|²)) · s/|s| (Sabour et al. eq. 1)."""
+    sq = jnp.sum(jnp.square(s), axis=axis, keepdims=True)
+    return (sq / (1.0 + sq)) * s / jnp.sqrt(sq + eps)
+
+
+@dataclass
+class PrimaryCapsules(Layer):
+    """conf.layers.PrimaryCapsules: conv over the CNN input, reshaped into
+    capsules + squash. Output [B, capsule_dim, n_caps]."""
+
+    capsules: int = 8          # channels groups → n_caps = capsules * H' * W'
+    capsule_dim: int = 8
+    kernel_size: int = 3
+    stride: int = 2
+
+    def output_type(self, it: InputType) -> InputType:
+        h = (it.height - self.kernel_size) // self.stride + 1
+        w = (it.width - self.kernel_size) // self.stride + 1
+        return InputType.recurrent(self.capsule_dim, self.capsules * h * w)
+
+    def init_params(self, key, it: InputType, dtype=jnp.float32):
+        c_in = it.channels
+        out_ch = self.capsules * self.capsule_dim
+        fan_in = c_in * self.kernel_size ** 2
+        k1, _ = jax.random.split(key)
+        return {"W": init_weights(k1, (out_ch, c_in, self.kernel_size,
+                                       self.kernel_size),
+                                  fan_in, out_ch, self.weight_init, dtype),
+                "b": jnp.zeros((out_ch,), dtype)}
+
+    def forward(self, params, x, it, *, training, rng=None):
+        z = jax.lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride, self.stride),
+            padding="VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        z = z + params["b"][None, :, None, None]
+        B = z.shape[0]
+        # [B, caps*dim, H, W] → [B, dim, caps*H*W]
+        caps = z.reshape(B, self.capsules, self.capsule_dim, -1)
+        caps = caps.transpose(0, 2, 1, 3).reshape(B, self.capsule_dim, -1)
+        return squash(caps, axis=1)
+
+
+@dataclass
+class CapsuleLayer(Layer):
+    """conf.layers.CapsuleLayer: dynamic routing between capsule sets.
+    Input [B, in_dim, in_caps] → output [B, capsule_dim, capsules]."""
+
+    capsules: int = 10
+    capsule_dim: int = 16
+    routings: int = 3
+
+    def __post_init__(self):
+        if self.routings < 1:
+            raise ValueError(f"routings must be >= 1, got {self.routings}")
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.capsule_dim, self.capsules)
+
+    def init_params(self, key, it: InputType, dtype=jnp.float32):
+        in_caps, in_dim = it.timeseries_length, it.size
+        if in_caps is None:
+            raise ValueError(
+                "CapsuleLayer needs a known input capsule count: the incoming "
+                "InputType has timeseries_length=None — set the sequence "
+                "length in set_input_type / the upstream layer")
+        k1, _ = jax.random.split(key)
+        return {"W": init_weights(
+            k1, (self.capsules, in_caps, in_dim, self.capsule_dim),
+            in_dim, self.capsule_dim, self.weight_init, dtype)}
+
+    def forward(self, params, x, it, *, training, rng=None):
+        u = x.transpose(0, 2, 1)                              # [B, in_caps, in_dim]
+        # prediction vectors û[j|i] = W_ij u_i : [B, out_caps, in_caps, out_dim]
+        u_hat = jnp.einsum("bid,jide->bjie", u, params["W"])
+        B, J, I, E = u_hat.shape
+        b = jnp.zeros((B, J, I), u_hat.dtype)
+        u_hat_ng = jax.lax.stop_gradient(u_hat)
+        v = None
+        for r in range(self.routings):
+            c = jax.nn.softmax(b, axis=1)                     # couple over out caps
+            uh = u_hat if r == self.routings - 1 else u_hat_ng
+            s = jnp.einsum("bji,bjie->bje", c, uh)
+            v = squash(s, axis=-1)
+            if r < self.routings - 1:
+                b = b + jnp.einsum("bjie,bje->bji", u_hat_ng, v)
+        return v.transpose(0, 2, 1)                           # [B, dim, caps]
+
+
+@dataclass
+class CapsuleStrengthLayer(Layer):
+    """conf.layers.CapsuleStrengthLayer: capsule lengths → [B, n_caps]
+    (class 'probabilities' for the margin loss)."""
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(it.timeseries_length)
+
+    def forward(self, params, x, it, *, training, rng=None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=1) + 1e-12)
+
+
+def margin_loss(labels, lengths, m_plus=0.9, m_minus=0.1, lam=0.5):
+    """CapsNet margin loss (Sabour et al. eq. 4)."""
+    pos = labels * jnp.square(jnp.maximum(0.0, m_plus - lengths))
+    neg = lam * (1.0 - labels) * jnp.square(jnp.maximum(0.0, lengths - m_minus))
+    return jnp.mean(jnp.sum(pos + neg, axis=-1))
+
+
+@dataclass
+class CapsNetOutputLayer(Layer):
+    """Margin-loss head over capsule strengths (the reference pairs
+    CapsuleStrengthLayer with a loss layer; fused here)."""
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        return it
+
+    def forward(self, params, x, it, *, training, rng=None):
+        return x
+
+    def compute_loss(self, params, x, labels, it, *, training, rng=None, mask=None):
+        return margin_loss(labels, x.astype(jnp.float32))
+
+
+from .conf import LAYER_REGISTRY as _REG  # noqa: E402
+
+for _cls in (PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer,
+             CapsNetOutputLayer):
+    _REG[_cls.__name__] = _cls

@@ -271,3 +271,80 @@ from .conf import LAYER_REGISTRY as _REG  # noqa: E402
 for _cls in (Convolution3D, Subsampling3DLayer, LocallyConnected2D, PReLULayer,
              Cropping2D, CenterLossOutputLayer):
     _REG[_cls.__name__] = _cls
+
+
+@dataclass
+class Convolution1DLayer(Layer):
+    """conf.layers.Convolution1DLayer: NCW sequences [B, C, T] →
+    [B, n_out, T'] via XLA conv (reference generic/nn/convo/conv1d.cpp)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    convolution_mode: str = "same"  # same | truncate
+    has_bias: bool = True
+    activation: str = "identity"
+
+    def output_type(self, it: InputType) -> InputType:
+        T = it.timeseries_length
+        if T is not None:
+            T = _conv_out(T, self.kernel_size, self.stride, 0,
+                          self.convolution_mode == "same")
+        return InputType.recurrent(self.n_out, T)
+
+    def init_params(self, key, it: InputType, dtype=jnp.float32):
+        c_in = self.n_in or it.size
+        k1, _ = jax.random.split(key)
+        fan_in = c_in * self.kernel_size
+        p = {"W": init_weights(k1, (self.n_out, c_in, self.kernel_size),
+                               fan_in, self.n_out * self.kernel_size,
+                               self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def forward(self, params, x, it, *, training, rng=None):
+        x = self._apply_dropout(x, training, rng)
+        pad = "SAME" if self.convolution_mode == "same" else "VALID"
+        z = jax.lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride,), padding=pad,
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        if self.has_bias:
+            z = z + params["b"][None, :, None]
+        return act.get(self.activation)(z)
+
+
+@dataclass
+class Subsampling1DLayer(Layer):
+    """conf.layers.Subsampling1DLayer (max/avg pooling over time, NCW)."""
+
+    pooling_type: str = "max"
+    kernel_size: int = 2
+    stride: int = 2
+    convolution_mode: str = "truncate"
+
+    def has_params(self):
+        return False
+
+    def output_type(self, it: InputType) -> InputType:
+        T = it.timeseries_length
+        if T is not None:
+            T = _conv_out(T, self.kernel_size, self.stride, 0,
+                          self.convolution_mode == "same")
+        return InputType.recurrent(it.size, T)
+
+    def forward(self, params, x, it, *, training, rng=None):
+        pad = "SAME" if self.convolution_mode == "same" else "VALID"
+        dims = (1, 1, self.kernel_size)
+        strides = (1, 1, self.stride)
+        if self.pooling_type == "max":
+            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pad)
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pad)
+        c = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add, dims,
+                                  strides, pad)
+        return s / c
+
+
+for _cls in (Convolution1DLayer, Subsampling1DLayer):
+    _REG[_cls.__name__] = _cls

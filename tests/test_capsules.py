@@ -1,0 +1,74 @@
+"""CapsNet layers (C4/C16): squash, dynamic routing, end-to-end learning."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.capsules import (
+    CapsNetOutputLayer,
+    CapsuleLayer,
+    CapsuleStrengthLayer,
+    PrimaryCapsules,
+    margin_loss,
+    squash,
+)
+
+
+def test_squash_norm_below_one():
+    rs = np.random.RandomState(0)
+    v = np.asarray(squash(jnp.asarray(rs.randn(4, 8).astype(np.float32) * 5)))
+    norms = np.linalg.norm(v, axis=-1)
+    assert np.all(norms < 1.0)
+    # big inputs keep direction
+    big = np.array([[10.0, 0.0]], np.float32)
+    out = np.asarray(squash(jnp.asarray(big)))
+    assert out[0, 0] > 0.98 and abs(out[0, 1]) < 1e-6
+
+
+def test_margin_loss_prefers_correct_lengths():
+    y = np.eye(3, dtype=np.float32)[[0]]
+    good = np.array([[0.95, 0.05, 0.05]], np.float32)
+    bad = np.array([[0.05, 0.95, 0.95]], np.float32)
+    assert float(margin_loss(y, jnp.asarray(good))) < float(margin_loss(y, jnp.asarray(bad)))
+
+
+def test_capsnet_learns_synthetic_shapes():
+    """PrimaryCapsules → routing → strengths classifies two synthetic
+    patterns on 12x12 images."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import ConvolutionLayer, InputType
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(3e-3)).list()
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(5, 5), activation="relu"))
+            .layer(PrimaryCapsules(capsules=4, capsule_dim=4, kernel_size=3, stride=2))
+            .layer(CapsuleLayer(capsules=2, capsule_dim=8, routings=3))
+            .layer(CapsuleStrengthLayer())
+            .layer(CapsNetOutputLayer())
+            .set_input_type(InputType.convolutional(12, 12, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(1)
+    n = 64
+    y = rs.randint(0, 2, n)
+    x = rs.randn(n, 1, 12, 12).astype(np.float32) * 0.1
+    for i, c in enumerate(y):
+        if c == 0:
+            x[i, 0, 3, :] += 2.0        # horizontal bar
+        else:
+            x[i, 0, :, 3] += 2.0        # vertical bar
+    labels = np.eye(2, dtype=np.float32)[y]
+
+    out = net.output(x[:4]).numpy()
+    assert out.shape == (4, 2)
+    s0 = None
+    for _ in range(40):
+        net._fit_batch(DataSet(x, labels))
+        if s0 is None:
+            s0 = net.score_
+    assert net.score_ < s0 * 0.5
+    preds = net.output(x).numpy().argmax(-1)
+    assert (preds == y).mean() > 0.9

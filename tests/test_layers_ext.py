@@ -240,3 +240,54 @@ def test_cg_constraints_and_weight_noise():
     o1 = g.output_single(x).numpy()
     o2 = g.output_single(x).numpy()
     np.testing.assert_array_equal(o1, o2)
+
+
+class TestConv1DFamily:
+    """C4 Conv1D family: NCW conv + pooling over sequences."""
+
+    def test_shapes_and_learning(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import GlobalPoolingLayer, InputType, OutputLayer
+        from deeplearning4j_tpu.nn.layers_ext import Convolution1DLayer, Subsampling1DLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(5e-3)).list()
+                .layer(Convolution1DLayer(n_in=4, n_out=8, kernel_size=3,
+                                          activation="relu"))
+                .layer(Subsampling1DLayer(kernel_size=2, stride=2))
+                .layer(Convolution1DLayer(n_out=8, kernel_size=3, activation="relu"))
+                .layer(GlobalPoolingLayer(pooling_type="max"))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(4, 16))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        # class 0: a bump early in the sequence; class 1: late
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 4, 16).astype(np.float32) * 0.1
+        y = rs.randint(0, 2, 64)
+        for i, c in enumerate(y):
+            x[i, :, 2 if c == 0 else 12] += 2.0
+        labels = np.eye(2, dtype=np.float32)[y]
+        out = net.output(x[:4]).numpy()
+        assert out.shape == (4, 2)
+        for _ in range(60):
+            net._fit_batch(DataSet(x, labels))
+        preds = net.output(x).numpy().argmax(-1)
+        assert (preds == y).mean() > 0.9
+
+    def test_conf_json_roundtrip(self):
+        from deeplearning4j_tpu.nn import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import InputType, MultiLayerConfiguration, OutputLayer
+        from deeplearning4j_tpu.nn.layers_ext import Convolution1DLayer, Subsampling1DLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-3)).list()
+                .layer(Convolution1DLayer(n_in=3, n_out=5, kernel_size=3))
+                .layer(Subsampling1DLayer())
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(3, 8))
+                .build())
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert type(back.layers[0]).__name__ == "Convolution1DLayer"
+        assert back.layers[0].kernel_size == 3
