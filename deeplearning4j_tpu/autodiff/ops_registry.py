@@ -863,3 +863,9 @@ def _rpoisson(rng, shape, lam=1.0):
 @op("random_shuffle")
 def _rshuffle(rng, x):
     return jax.random.permutation(rng, x, axis=0)
+
+
+# wave-3 corpus (CTC, fused RNN cells, unsorted segments, TF-compat image /
+# space-batch, linalg tail, skipgram/cbow training ops) registers itself into
+# this same table on import — keep last so the decorator sees a full module.
+from . import ops_wave3  # noqa: E402,F401  (registration side effect)

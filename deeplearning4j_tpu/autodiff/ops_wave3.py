@@ -1,0 +1,1002 @@
+"""Op corpus wave 3 — closes the named N6 gaps (VERDICT r3 missing #2).
+
+Reference analog: ``libnd4j/include/ops/declarable/generic/**`` (SURVEY §2.1
+N6): the CTC family, fused/peephole recurrent units, the unsorted_segment_*
+family, TF-compat image/space-batch ops, LU/expm linalg tail, and the
+skipgram/cbow training ops that the reference exposes as declarable ops
+(``generic/nlp/sg_cb.cpp``). Everything is a jax-traceable callable except
+the beam-search decoder (host-side by design, like the reference's CPU
+helper). Registered into the same ``OPS`` table; the build-failing coverage
+gate in tests/test_op_validation.py applies to every name added here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .ops_registry import OPS, op
+
+_NEG = -1e30
+
+
+# ------------------------------------------------------------------ ctc family
+
+
+@op("ctc_loss")
+def _ctc_loss(labels, logits, label_lengths, logit_lengths, blank=0):
+    """CTC negative log-likelihood, mean over batch.
+
+    labels [B,S] int32, logits [B,T,C] raw scores, lengths [B].
+    Log-space alpha recursion as one ``lax.scan`` over time (ref:
+    generic/loss/ctcLoss.cpp); fully differentiable w.r.t. logits.
+    """
+    labels = jnp.asarray(labels, jnp.int32)
+    logits = jnp.asarray(logits)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32)
+    logit_lengths = jnp.asarray(logit_lengths, jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    B, T, C = logp.shape
+    S = labels.shape[1]
+    L = 2 * S + 1
+    ext = jnp.full((B, L), blank, jnp.int32).at[:, 1::2].set(labels)
+    pos = jnp.arange(L)
+    # skip transition s-2 -> s allowed where ext[s] != blank and != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :L]
+    can_skip = (ext != blank) & (ext != ext_m2) & (pos >= 2)
+
+    emit = jnp.take_along_axis(logp[:, :, :], ext[:, None, :], axis=2)  # [B,T,L]
+
+    a0 = jnp.full((B, L), _NEG)
+    a0 = a0.at[:, 0].set(emit[:, 0, 0])
+    a0 = a0.at[:, 1].set(emit[:, 0, 1])
+
+    def shift(x, k):
+        return jnp.pad(x, ((0, 0), (k, 0)), constant_values=_NEG)[:, :L]
+
+    def body(alpha, t):
+        stay = alpha
+        step1 = shift(alpha, 1)
+        step2 = jnp.where(can_skip, shift(alpha, 2), _NEG)
+        na = jnp.logaddexp(jnp.logaddexp(stay, step1), step2) + emit[:, t, :]
+        na = jnp.where((t < logit_lengths)[:, None], na, alpha)
+        return na, None
+
+    alpha, _ = lax.scan(body, a0, jnp.arange(1, T))
+    end = 2 * label_lengths  # final blank position
+    a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_last = jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(a_end, jnp.where(label_lengths > 0, a_last, _NEG))
+    return -jnp.mean(ll)
+
+
+@op("ctc_greedy_decoder")
+def _ctc_greedy_decoder(logits, logit_lengths=None, blank=0):
+    """Best-path decode: frame argmax, collapse repeats, drop blanks.
+
+    Returns (decoded [B,T] padded with -1, lengths [B]). Static shapes —
+    decoded is right-padded so the op stays jittable.
+    """
+    logits = jnp.asarray(logits)
+    B, T, C = logits.shape
+    path = jnp.argmax(logits, axis=-1)  # [B,T]
+    if logit_lengths is not None:
+        t_idx = jnp.arange(T)[None, :]
+        path = jnp.where(t_idx < jnp.asarray(logit_lengths, jnp.int32)[:, None], path, blank)
+    prev = jnp.pad(path, ((0, 0), (1, 0)), constant_values=-1)[:, :T]
+    keep = (path != blank) & (path != prev)
+
+    def compact(row_path, row_keep):
+        idx = jnp.cumsum(row_keep) - 1
+        out = jnp.full((T,), -1, path.dtype)
+        out = out.at[jnp.where(row_keep, idx, T)].set(row_path, mode="drop")
+        return out
+
+    decoded = jax.vmap(compact)(path, keep)
+    return decoded, jnp.sum(keep, axis=1)
+
+
+@op("ctc_beam_search_decoder")
+def _ctc_beam_search_decoder(logits, beam_width=8, blank=0, top_paths=1):
+    """Prefix beam search (no LM). Host-side numpy by design — dynamic
+    prefix sets don't map to static shapes (the reference's decoder is a
+    CPU helper too). Returns list of (sequence tuple, log_prob) per batch."""
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    B, T, C = logp.shape
+    results = []
+    for b in range(B):
+        # beams: prefix -> (log p ending in blank, log p ending in non-blank)
+        beams = {(): (0.0, _NEG)}
+        for t in range(T):
+            new = {}
+
+            def add(pref, pb, pnb):
+                opb, opnb = new.get(pref, (_NEG, _NEG))
+                new[pref] = (np.logaddexp(opb, pb), np.logaddexp(opnb, pnb))
+
+            for pref, (pb, pnb) in beams.items():
+                total = np.logaddexp(pb, pnb)
+                add(pref, total + logp[b, t, blank], _NEG)  # extend with blank
+                for c in range(C):
+                    if c == blank:
+                        continue
+                    p_c = logp[b, t, c]
+                    if pref and pref[-1] == c:
+                        add(pref, _NEG, pnb + p_c)         # repeat emission merges
+                        add(pref + (c,), _NEG, pb + p_c)   # new symbol needs blank gap
+                    else:
+                        add(pref + (c,), _NEG, total + p_c)
+            beams = dict(sorted(new.items(), key=lambda kv: -np.logaddexp(*kv[1]))[:beam_width])
+        ranked = sorted(((pref, float(np.logaddexp(pb, pnb)))
+                         for pref, (pb, pnb) in beams.items()), key=lambda kv: -kv[1])
+        results.append(ranked[:top_paths])
+    return results
+
+
+# ------------------------------------------------------- fused recurrent units
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@op("lstm_cell")
+def _lstm_cell(x, h_prev, c_prev, wx, wh, b):
+    """One LSTM step, gates fused in one [.,4H] GEMM (i,f,g,o order)."""
+    H = h_prev.shape[-1]
+    z = x @ wx + h_prev @ wh + b
+    i, f, g, o = z[..., :H], z[..., H:2 * H], z[..., 2 * H:3 * H], z[..., 3 * H:]
+    c = _sigmoid(f) * c_prev + _sigmoid(i) * jnp.tanh(g)
+    h = _sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+@op("lstm_block")
+def _lstm_block(x, h0, c0, wx, wh, b, wci=None, wcf=None, wco=None):
+    """Full-sequence LSTM with optional peepholes (ref: lstmBlock /
+    lstmBlockCell, generic/nn/recurrent/lstmBlock.cpp). x [T,B,I]; one
+    ``lax.scan`` over time — per-step gates are a single fused GEMM on the
+    MXU. Returns (ys [T,B,H], h_T, c_T)."""
+    H = h0.shape[-1]
+    use_peep = wci is not None
+
+    def step(carry, xt):
+        h, c = carry
+        z = xt @ wx + h @ wh + b
+        i, f, g, o = z[..., :H], z[..., H:2 * H], z[..., 2 * H:3 * H], z[..., 3 * H:]
+        if use_peep:
+            i = i + c * wci
+            f = f + c * wcf
+        cn = _sigmoid(f) * c + _sigmoid(i) * jnp.tanh(g)
+        if use_peep:
+            o = o + cn * wco
+        hn = _sigmoid(o) * jnp.tanh(cn)
+        return (hn, cn), hn
+
+    (hT, cT), ys = lax.scan(step, (h0, c0), x)
+    return ys, hT, cT
+
+
+@op("sru")
+def _sru(x, c0, w, wf, wr, bf, br):
+    """Simple Recurrent Unit (ref: generic/nn/recurrent/sru.cpp; Lei et al.
+    2017). The heavy lifting (all three projections) is time-parallel — the
+    scan carries only the cheap elementwise recurrence, the TPU-native way
+    to run this cell. x [T,B,I] -> (h [T,B,H], c_T)."""
+    xt = x @ w        # [T,B,H]
+    f = _sigmoid(x @ wf + bf)
+    r = _sigmoid(x @ wr + br)
+
+    def step(c, tfr):
+        xt_t, f_t, r_t = tfr
+        cn = f_t * c + (1.0 - f_t) * xt_t
+        h = r_t * jnp.tanh(cn) + (1.0 - r_t) * xt_t
+        return cn, h
+
+    cT, h = lax.scan(step, c0, (xt, f, r))
+    return h, cT
+
+
+@op("sru_cell")
+def _sru_cell(x, c_prev, w, wf, wr, bf, br):
+    xt = x @ w
+    f = _sigmoid(x @ wf + bf)
+    r = _sigmoid(x @ wr + br)
+    c = f * c_prev + (1.0 - f) * xt
+    h = r * jnp.tanh(c) + (1.0 - r) * xt
+    return h, c
+
+
+@op("gru_cell")
+def _gru_cell(x, h_prev, wx, wh, b):
+    """One GRU step (r,u,n gate order, matching the sequence 'gru' op)."""
+    H = h_prev.shape[-1]
+    xz = x @ wx + b
+    hz = h_prev @ wh
+    r = _sigmoid(xz[..., :H] + hz[..., :H])
+    u = _sigmoid(xz[..., H:2 * H] + hz[..., H:2 * H])
+    n = jnp.tanh(xz[..., 2 * H:] + r * hz[..., 2 * H:])
+    return (1.0 - u) * n + u * h_prev
+
+
+# ------------------------------------------------------ unsorted segment family
+
+
+def _useg(reducer, init, x, ids, num_segments):
+    ids = jnp.asarray(ids, jnp.int32)
+    out = jnp.full((num_segments,) + x.shape[1:], init, x.dtype)
+    return reducer(out, ids, x)
+
+
+@op("unsorted_segment_max")
+def _unsorted_segment_max(x, ids, num_segments):
+    return _useg(lambda o, i, v: o.at[i].max(v, mode="drop"), -jnp.inf, x, ids, num_segments)
+
+
+@op("unsorted_segment_min")
+def _unsorted_segment_min(x, ids, num_segments):
+    return _useg(lambda o, i, v: o.at[i].min(v, mode="drop"), jnp.inf, x, ids, num_segments)
+
+
+@op("unsorted_segment_prod")
+def _unsorted_segment_prod(x, ids, num_segments):
+    return _useg(lambda o, i, v: o.at[i].multiply(v, mode="drop"), 1, x, ids, num_segments)
+
+
+@op("unsorted_segment_mean")
+def _unsorted_segment_mean(x, ids, num_segments):
+    ids = jnp.asarray(ids, jnp.int32)
+    s = jnp.zeros((num_segments,) + x.shape[1:], x.dtype).at[ids].add(x, mode="drop")
+    n = jnp.zeros((num_segments,), x.dtype).at[ids].add(1.0, mode="drop")
+    return s / jnp.maximum(n, 1).reshape((-1,) + (1,) * (x.ndim - 1))
+
+
+@op("unsorted_segment_sqrt_n")
+def _unsorted_segment_sqrt_n(x, ids, num_segments):
+    ids = jnp.asarray(ids, jnp.int32)
+    s = jnp.zeros((num_segments,) + x.shape[1:], x.dtype).at[ids].add(x, mode="drop")
+    n = jnp.zeros((num_segments,), x.dtype).at[ids].add(1.0, mode="drop")
+    return s / jnp.sqrt(jnp.maximum(n, 1)).reshape((-1,) + (1,) * (x.ndim - 1))
+
+
+# ------------------------------------------------------------- image/space ops
+
+
+@op("extract_image_patches")
+def _extract_image_patches(x, ksizes, strides=(1, 1), rates=(1, 1), padding="VALID"):
+    """TF-compat patch extraction. x [B,H,W,C] -> [B,OH,OW,KH*KW*C]."""
+    kh, kw = ksizes
+    x_nchw = jnp.transpose(x, (0, 3, 1, 2))
+    patches = lax.conv_general_dilated_patches(
+        x_nchw, (kh, kw), strides, padding, rhs_dilation=rates)
+    # [B, C*KH*KW, OH, OW] with C slowest — reorder to TF's KH,KW,C fastest-C
+    B, _, OH, OW = patches.shape
+    C = x.shape[3]
+    p = patches.reshape(B, C, kh * kw, OH, OW)
+    p = jnp.transpose(p, (0, 3, 4, 2, 1))  # [B,OH,OW,KH*KW,C]
+    return p.reshape(B, OH, OW, kh * kw * C)
+
+
+@op("im2col")
+def _im2col(x, kernel=(3, 3), strides=(1, 1), padding="SAME", dilation=(1, 1)):
+    """NCHW im2col: [B,C,H,W] -> [B, C*KH*KW, OH, OW] (ref: helpers/im2col)."""
+    return lax.conv_general_dilated_patches(x, tuple(kernel), tuple(strides),
+                                            padding, rhs_dilation=tuple(dilation))
+
+
+@op("col2im")
+def _col2im(cols, input_shape, kernel=(3, 3), strides=(1, 1), padding="SAME", dilation=(1, 1)):
+    """Adjoint of im2col (scatter-add of patches) — derived as the exact VJP
+    of the im2col lowering rather than re-implementing the index arithmetic."""
+    primal = jnp.zeros(input_shape, cols.dtype)
+    _, vjp = jax.vjp(lambda x: _im2col(x, kernel, strides, padding, dilation), primal)
+    return vjp(cols)[0]
+
+
+@op("space_to_batch_nd")
+def _space_to_batch_nd(x, block_shape, paddings):
+    """TF SpaceToBatchND: x [B, S1..Sn, ...] with n spatial dims."""
+    block_shape = list(block_shape)
+    n = len(block_shape)
+    pads = [(0, 0)] + [tuple(p) for p in paddings] + [(0, 0)] * (x.ndim - 1 - n)
+    x = jnp.pad(x, pads)
+    B = x.shape[0]
+    rest = list(x.shape[1 + n:])
+    outer = [x.shape[1 + i] // block_shape[i] for i in range(n)]
+    shape = [B]
+    for i in range(n):
+        shape += [outer[i], block_shape[i]]
+    x = x.reshape(shape + rest)
+    # blocks in front of batch: [b1..bn, B, S1/b1..Sn/bn, rest]
+    perm = [2 + 2 * i for i in range(n)] + [0] + [1 + 2 * i for i in range(n)]
+    perm += list(range(1 + 2 * n, x.ndim))
+    x = jnp.transpose(x, perm)
+    return x.reshape([B * int(np.prod(block_shape))] + outer + rest)
+
+
+@op("batch_to_space_nd")
+def _batch_to_space_nd(x, block_shape, crops):
+    block_shape = list(block_shape)
+    n = len(block_shape)
+    prod = int(np.prod(block_shape))
+    B = x.shape[0] // prod
+    spatial = list(x.shape[1 : 1 + n])
+    rest = list(x.shape[1 + n:])
+    x = x.reshape(block_shape + [B] + spatial + rest)
+    # interleave: [B, S1, b1, S2, b2, ...]
+    perm = [n]
+    for i in range(n):
+        perm += [n + 1 + i, i]
+    perm += list(range(1 + 2 * n, x.ndim))
+    x = jnp.transpose(x, perm)
+    x = x.reshape([B] + [s * b for s, b in zip(spatial, block_shape)] + rest)
+    for i in range(n):
+        lo, hi = crops[i]
+        size = x.shape[1 + i] - lo - hi
+        x = lax.slice_in_dim(x, lo, lo + size, axis=1 + i)
+    return x
+
+
+@op("space_to_batch")
+def _space_to_batch(x, block_size, paddings=((0, 0), (0, 0))):
+    """2D special case, NHWC (ref: generic/parity_ops/space_to_batch.cpp)."""
+    return _space_to_batch_nd(x, (block_size, block_size), paddings)
+
+
+@op("batch_to_space")
+def _batch_to_space(x, block_size, crops=((0, 0), (0, 0))):
+    return _batch_to_space_nd(x, (block_size, block_size), crops)
+
+
+@op("resize_bicubic")
+def _resize_bicubic(x, size):
+    """NCHW bicubic resize via jax.image (keys-cubic kernel)."""
+    B, C, H, W = x.shape
+    return jax.image.resize(x, (B, C, size[0], size[1]), method="cubic")
+
+
+@op("resize_area")
+def _resize_area(x, size):
+    """Area (box-average) resize. Integer downscale = exact mean pooling;
+    otherwise antialiased linear (documented approximation)."""
+    B, C, H, W = x.shape
+    oh, ow = size
+    if H % oh == 0 and W % ow == 0:
+        fh, fw = H // oh, W // ow
+        return x.reshape(B, C, oh, fh, ow, fw).mean(axis=(3, 5))
+    return jax.image.resize(x, (B, C, oh, ow), method="linear", antialias=True)
+
+
+@op("crop_and_resize")
+def _crop_and_resize(image, boxes, box_indices, crop_size):
+    """TF CropAndResize, bilinear. image [B,H,W,C], boxes [N,4] normalized
+    (y1,x1,y2,x2), box_indices [N] -> [N,ch,cw,C]."""
+    image = jnp.asarray(image)
+    boxes = jnp.asarray(boxes)
+    box_indices = jnp.asarray(box_indices, jnp.int32)
+    H, W = image.shape[1], image.shape[2]
+    ch, cw = crop_size
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        ys = y1 * (H - 1) + (jnp.arange(ch) / jnp.maximum(ch - 1, 1)) * (y2 - y1) * (H - 1)
+        xs = x1 * (W - 1) + (jnp.arange(cw) / jnp.maximum(cw - 1, 1)) * (x2 - x1) * (W - 1)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = (ys - y0).clip(0, 1)[:, None, None]
+        wx = (xs - x0).clip(0, 1)[None, :, None]
+        img = image[bi]
+        a = img[y0][:, x0] * (1 - wy) * (1 - wx)
+        b = img[y0][:, x1i] * (1 - wy) * wx
+        c = img[y1i][:, x0] * wy * (1 - wx)
+        d = img[y1i][:, x1i] * wy * wx
+        return a + b + c + d
+
+    return jax.vmap(one)(boxes, box_indices)
+
+
+def _rgb_hsv_fwd(r, g, b):
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0)) / 6.0
+    h = jnp.where(d == 0, 0.0, h)
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return h, s, mx
+
+
+@op("rgb_to_hsv")
+def _rgb_to_hsv(x):
+    """Channels-last [...,3] in [0,1] (ref: generic/images/rgb_to_hsv)."""
+    h, s, v = _rgb_hsv_fwd(x[..., 0], x[..., 1], x[..., 2])
+    return jnp.stack([h, s, v], axis=-1)
+
+
+@op("hsv_to_rgb")
+def _hsv_to_rgb(x):
+    h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], axis=-1)
+
+
+@op("rgb_to_grs")
+def _rgb_to_grs(x):
+    """ITU-R BT.601 luma, channels-last [...,3] -> [...,1]."""
+    w = jnp.asarray([0.299, 0.587, 0.114], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+@op("adjust_hue")
+def _adjust_hue(x, delta):
+    hsv = _rgb_to_hsv(x)
+    h = (hsv[..., 0] + delta) % 1.0
+    return _hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+@op("adjust_saturation")
+def _adjust_saturation(x, factor):
+    hsv = _rgb_to_hsv(x)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return _hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+@op("non_max_suppression")
+def _non_max_suppression(boxes, scores, max_output_size, iou_threshold=0.5):
+    """Greedy NMS. boxes [N,4] (y1,x1,y2,x2) -> (indices [max_output_size]
+    padded with -1, valid count). Static shapes (lax.fori_loop selection)."""
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
+    N = boxes.shape[0]
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+
+    def iou(i, mask):
+        b = boxes[i]
+        yy1 = jnp.maximum(b[0], boxes[:, 0])
+        xx1 = jnp.maximum(b[1], boxes[:, 1])
+        yy2 = jnp.minimum(b[2], boxes[:, 2])
+        xx2 = jnp.minimum(b[3], boxes[:, 3])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(area[i] + area - inter, 1e-9)
+
+    def body(k, state):
+        sel, alive, live_scores = state
+        i = jnp.argmax(live_scores)
+        ok = live_scores[i] > _NEG / 2
+        sel = sel.at[k].set(jnp.where(ok, i, -1))
+        kill = (iou(i, alive) > iou_threshold) | (jnp.arange(N) == i)
+        alive = alive & ~kill & ok
+        live_scores = jnp.where(alive, scores, _NEG)
+        return sel, alive, live_scores
+
+    sel0 = jnp.full((max_output_size,), -1, jnp.int32)
+    alive0 = jnp.ones((N,), bool)
+    sel, _, _ = lax.fori_loop(0, max_output_size, body,
+                              (sel0, alive0, jnp.where(alive0, scores, _NEG)))
+    return sel, jnp.sum(sel >= 0)
+
+
+@op("max_pool_with_argmax")
+def _max_pool_with_argmax(x, kernel=(2, 2), strides=(2, 2), padding="VALID"):
+    """NCHW max pool returning (values, flat HW argmax indices) — TF
+    semantics where the index is into the flattened H*W plane. VALID only:
+    under SAME the patch extraction zero-pads, so an argmax could name a pad
+    cell with no in-plane index."""
+    if padding != "VALID":
+        raise NotImplementedError("max_pool_with_argmax supports VALID padding only")
+    B, C, H, W = x.shape
+    patches = lax.conv_general_dilated_patches(x, kernel, strides, padding)
+    _, CKK, OH, OW = patches.shape
+    kk = kernel[0] * kernel[1]
+    p = patches.reshape(B, C, kk, OH, OW)
+    vals = p.max(axis=2)
+    local = p.argmax(axis=2)  # 0..kk-1
+    oh = jnp.arange(OH)[:, None]
+    ow = jnp.arange(OW)[None, :]
+    kh_off = local // kernel[1]
+    kw_off = local % kernel[1]
+    flat = (oh * strides[0] + kh_off) * W + (ow * strides[1] + kw_off)
+    return vals, flat.astype(jnp.int32)
+
+
+@op("fused_batch_norm")
+def _fused_batch_norm(x, scale, offset, eps=1e-3):
+    """Training-mode fused BN over NHWC [B,H,W,C] -> (y, mean, var).
+
+    One-pass statistics (sum + sum-of-squares in a single fused reduction)
+    — the r4 bandwidth optimization, see nn/conf.py BatchNormalization."""
+    n = x.shape[0] * x.shape[1] * x.shape[2]
+    s1 = jnp.sum(x, axis=(0, 1, 2))
+    s2 = jnp.sum(x * x, axis=(0, 1, 2))
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    y = (x - mean) * lax.rsqrt(var + eps) * scale + offset
+    return y, mean, var
+
+
+@op("mirror_pad")
+def _mirror_pad(x, paddings, mode="REFLECT"):
+    np_mode = "reflect" if mode.upper() == "REFLECT" else "symmetric"
+    return jnp.pad(x, [tuple(p) for p in paddings], mode=np_mode)
+
+
+@op("upsampling3d")
+def _upsampling3d(x, factor):
+    """NCDHW nearest upsample by integer factor."""
+    f = (factor, factor, factor) if isinstance(factor, int) else tuple(factor)
+    x = jnp.repeat(x, f[0], axis=2)
+    x = jnp.repeat(x, f[1], axis=3)
+    return jnp.repeat(x, f[2], axis=4)
+
+
+# ------------------------------------------------------------------- linalg
+
+
+@op("lu")
+def _lu(a):
+    """LU with partial pivoting -> (P, L, U) with P @ A = L @ U... returned
+    as TF-style (lu_matrix, permutation_vector)? We follow scipy: (p, l, u)
+    permutation MATRIX such that a = p @ l @ u."""
+    import jax.scipy.linalg as jsl
+
+    return jsl.lu(a)
+
+
+@op("matrix_exp")
+def _matrix_exp(a):
+    import jax.scipy.linalg as jsl
+
+    return jsl.expm(a)
+
+
+@op("sqrtm")
+def _sqrtm(a):
+    import jax.scipy.linalg as jsl
+
+    return jsl.sqrtm(a)
+
+
+@op("pinv")
+def _pinv(a):
+    return jnp.linalg.pinv(a)
+
+
+@op("kron")
+def _kron(a, b):
+    return jnp.kron(a, b)
+
+
+@op("matrix_power")
+def _matrix_power(a, n):
+    return jnp.linalg.matrix_power(a, n)
+
+
+@op("tri")
+def _tri(n, m=None, k=0):
+    return jnp.tri(n, m, k)
+
+
+@op("diag_part")
+def _diag_part(x):
+    return jnp.diagonal(x, axis1=-2, axis2=-1)
+
+
+# ----------------------------------------------------------- sg/cb train ops
+
+
+@op("skipgram")
+def _skipgram(syn0, syn1neg, center, context, negatives, lr=0.025):
+    """One skip-gram negative-sampling update as a pure function (ref:
+    generic/nlp/sg_cb.cpp skipgram op — there mutating, here functional:
+    returns (new_syn0, new_syn1neg)). center/context [B], negatives [B,K]."""
+    syn0 = jnp.asarray(syn0)
+    syn1neg = jnp.asarray(syn1neg)
+    center = jnp.asarray(center, jnp.int32)
+    context = jnp.asarray(context, jnp.int32)
+    negatives = jnp.asarray(negatives, jnp.int32)
+    h = syn0[center]                                     # [B,D]
+    targets = jnp.concatenate([context[:, None], negatives], axis=1)  # [B,1+K]
+    labels = jnp.zeros(targets.shape, syn0.dtype).at[:, 0].set(1.0)
+    w = syn1neg[targets]                                 # [B,1+K,D]
+    logits = jnp.einsum("bd,bkd->bk", h, w)
+    g = (jax.nn.sigmoid(logits) - labels) * lr           # [B,1+K]
+    dh = jnp.einsum("bk,bkd->bd", g, w)
+    dw = g[..., None] * h[:, None, :]
+    new_syn0 = syn0.at[center].add(-dh)
+    new_syn1 = syn1neg.at[targets.reshape(-1)].add(-dw.reshape(-1, dw.shape[-1]))
+    return new_syn0, new_syn1
+
+
+@op("cbow")
+def _cbow(syn0, syn1neg, context_window, target, negatives, lr=0.025):
+    """CBOW-NS update: h = mean of context rows. context_window [B,W],
+    target [B], negatives [B,K] -> (new_syn0, new_syn1neg)."""
+    syn0 = jnp.asarray(syn0)
+    syn1neg = jnp.asarray(syn1neg)
+    ctx = jnp.asarray(context_window, jnp.int32)
+    target = jnp.asarray(target, jnp.int32)
+    negatives = jnp.asarray(negatives, jnp.int32)
+    W = ctx.shape[1]
+    h = syn0[ctx].mean(axis=1)                            # [B,D]
+    targets = jnp.concatenate([target[:, None], negatives], axis=1)
+    labels = jnp.zeros(targets.shape, syn0.dtype).at[:, 0].set(1.0)
+    w = syn1neg[targets]
+    logits = jnp.einsum("bd,bkd->bk", h, w)
+    g = (jax.nn.sigmoid(logits) - labels) * lr
+    dh = jnp.einsum("bk,bkd->bd", g, w) / W               # spread over window
+    dw = g[..., None] * h[:, None, :]
+    new_syn0 = syn0.at[ctx.reshape(-1)].add(-jnp.repeat(dh, W, axis=0))
+    new_syn1 = syn1neg.at[targets.reshape(-1)].add(-dw.reshape(-1, dw.shape[-1]))
+    return new_syn0, new_syn1
+
+
+# ------------------------------------------------------------ reductions tail
+
+
+@op("reduce_logsumexp")
+def _reduce_logsumexp(x, dims=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=dims, keepdims=keepdims)
+
+
+@op("count_nonzero")
+def _count_nonzero(x, dims=None):
+    return jnp.sum((x != 0).astype(jnp.int32), axis=dims)
+
+
+@op("count_zero")
+def _count_zero(x, dims=None):
+    return jnp.sum((x == 0).astype(jnp.int32), axis=dims)
+
+
+@op("zero_fraction")
+def _zero_fraction(x):
+    return jnp.mean((x == 0).astype(jnp.float32))
+
+
+@op("amax")
+def _amax(x, dims=None, keepdims=False):
+    return jnp.max(jnp.abs(x), axis=dims, keepdims=keepdims)
+
+
+@op("amin")
+def _amin(x, dims=None, keepdims=False):
+    return jnp.min(jnp.abs(x), axis=dims, keepdims=keepdims)
+
+
+@op("amean")
+def _amean(x, dims=None, keepdims=False):
+    return jnp.mean(jnp.abs(x), axis=dims, keepdims=keepdims)
+
+
+@op("asum")
+def _asum(x, dims=None, keepdims=False):
+    return jnp.sum(jnp.abs(x), axis=dims, keepdims=keepdims)
+
+
+@op("reduce_dot")
+def _reduce_dot(a, b, dims=None):
+    return jnp.sum(a * b, axis=dims)
+
+
+@op("sqnorm")
+def _sqnorm(x, dims=None, keepdims=False):
+    return jnp.sum(jnp.square(x), axis=dims, keepdims=keepdims)
+
+
+@op("percentile")
+def _percentile(x, q, dims=None):
+    return jnp.percentile(x, q, axis=dims)
+
+
+@op("median")
+def _median(x, dims=None):
+    return jnp.median(x, axis=dims)
+
+
+# --------------------------------------------------------- broadcastable tail
+
+
+@op("truncatediv")
+def _truncatediv(a, b):
+    return jnp.trunc(a / b)
+
+
+@op("divide_no_nan")
+def _divide_no_nan(a, b):
+    return jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b))
+
+
+@op("realdiv")
+def _realdiv(a, b):
+    return a / b
+
+
+@op("floormod")
+def _floormod(a, b):
+    return a - jnp.floor(a / b) * b
+
+
+@op("logaddexp")
+def _logaddexp(a, b):
+    return jnp.logaddexp(a, b)
+
+
+@op("zeta")
+def _zeta(x, q):
+    return jax.scipy.special.zeta(x, q)
+
+
+# ----------------------------------------------------------------- merge ops
+
+
+@op("mergeadd")
+def _mergeadd(*xs):
+    return sum(xs[1:], xs[0])
+
+
+@op("mergeavg")
+def _mergeavg(*xs):
+    return sum(xs[1:], xs[0]) / len(xs)
+
+
+@op("mergemax")
+def _mergemax(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.maximum(out, x)
+    return out
+
+
+@op("accumulate_n")
+def _accumulate_n(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+# ------------------------------------------------------------- shape/misc tail
+
+
+@op("invert_permutation")
+def _invert_permutation(p):
+    p = jnp.asarray(p, jnp.int32)
+    return jnp.zeros_like(p).at[p].set(jnp.arange(p.shape[0], dtype=p.dtype))
+
+
+@op("unique")
+def _unique(x, size=None):
+    """Sorted unique values. ``size`` required under jit (static shapes);
+    eager calls may omit it (host round trip, like the reference's CPU op)."""
+    if size is None:
+        return jnp.unique(np.asarray(x))
+    return jnp.unique(x, size=size)
+
+
+@op("unique_with_counts")
+def _unique_with_counts(x, size=None):
+    if size is None:
+        return jnp.unique(np.asarray(x), return_counts=True)
+    return jnp.unique(x, size=size, return_counts=True)
+
+
+@op("listdiff")
+def _listdiff(x, y):
+    """Values (and indices) in x not present in y. Host-side (dynamic)."""
+    x = np.asarray(x)
+    mask = ~np.isin(x, np.asarray(y))
+    return x[mask], np.nonzero(mask)[0].astype(np.int32)
+
+
+@op("nth_element")
+def _nth_element(x, n, reverse=False):
+    s = jnp.sort(x, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return s[..., n]
+
+
+@op("histogram")
+def _histogram(x, bins=10, range=None):
+    return jnp.histogram(x, bins=bins, range=range)[0]
+
+
+@op("histogram_fixed_width")
+def _histogram_fixed_width(x, value_range, nbins=100):
+    lo, hi = value_range
+    idx = jnp.clip(((x - lo) / (hi - lo) * nbins).astype(jnp.int32), 0, nbins - 1)
+    return jnp.zeros((nbins,), jnp.int32).at[idx.reshape(-1)].add(1)
+
+
+@op("nonzero")
+def _nonzero(x, size=None):
+    if size is None:
+        return jnp.stack(jnp.nonzero(np.asarray(x)), axis=1)
+    return jnp.stack(jnp.nonzero(x, size=size), axis=1)
+
+
+@op("searchsorted")
+def _searchsorted(sorted_seq, values, side="left"):
+    return jnp.searchsorted(sorted_seq, values, side=side)
+
+
+@op("bucketize")
+def _bucketize(x, boundaries):
+    return jnp.searchsorted(jnp.asarray(boundaries), x, side="right")
+
+
+@op("clip_by_avg_norm")
+def _clip_by_avg_norm(x, clip):
+    avg = jnp.sqrt(jnp.mean(jnp.square(x)))
+    return x * jnp.minimum(1.0, clip / jnp.maximum(avg, 1e-12))
+
+
+@op("clip_by_global_norm")
+def _clip_by_global_norm(xs, clip):
+    g = jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in xs))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(g, 1e-12))
+    return [x * scale for x in xs]
+
+
+@op("check_numerics")
+def _check_numerics(x, message="check_numerics"):
+    return lax.cond(jnp.all(jnp.isfinite(x)), lambda: x,
+                    lambda: x * jnp.nan)  # poison output, parity with panic mode
+
+
+@op("assign")
+def _assign(ref, value):
+    return jnp.broadcast_to(value, jnp.shape(ref)).astype(jnp.asarray(ref).dtype)
+
+
+@op("identity")
+def _identity(x):
+    return jnp.asarray(x)
+
+
+@op("stop_gradient")
+def _stop_gradient(x):
+    return lax.stop_gradient(x)
+
+
+@op("nan_to_num")
+def _nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@op("dynamic_partition")
+def _dynamic_partition(x, partitions, num_partitions):
+    """Host-side (output shapes are data-dependent, as in the reference)."""
+    x = np.asarray(x)
+    partitions = np.asarray(partitions)
+    return [x[partitions == i] for i in range(num_partitions)]
+
+
+@op("split_v")
+def _split_v(x, sizes, axis=0):
+    out = []
+    off = 0
+    for s in sizes:
+        out.append(lax.slice_in_dim(x, off, off + s, axis=axis))
+        off += s
+    return out
+
+
+@op("batch_gather")
+def _batch_gather(x, indices):
+    """Gather along axis 1 with a leading shared batch dim."""
+    return jnp.take_along_axis(
+        x, jnp.asarray(indices, jnp.int32).reshape(indices.shape + (1,) * (x.ndim - indices.ndim)),
+        axis=1)
+
+
+@op("logspace")
+def _logspace(start, stop, num, base=10.0):
+    return jnp.logspace(start, stop, num, base=base)
+
+
+@op("step_fn")
+def _step_fn(x):
+    """Unit step (nd4j legacy 'step' transform)."""
+    return (x > 0).astype(jnp.asarray(x).dtype if jnp.asarray(x).dtype.kind == "f" else jnp.float32)
+
+
+@op("rationaltanh")
+def _rationaltanh(x):
+    """nd4j legacy rational tanh approximation (softsign-family curve)."""
+    a = 1.7159 * x * (2.0 / 3.0)
+    return a / (1 + jnp.abs(a))
+
+
+@op("cyclic_rshift_bits")
+def _cyclic_rshift_bits(x, n):
+    bits = jnp.asarray(x).dtype.itemsize * 8
+    n = jnp.asarray(n, x.dtype)
+    return (x >> n) | (x << (bits - n))
+
+
+# ----------------------------------------------------------------- nn tail
+
+
+@op("bias_add")
+def _bias_add(x, b):
+    return x + b
+
+
+@op("xw_plus_b")
+def _xw_plus_b(x, w, b):
+    return x @ w + b
+
+
+@op("relu_layer")
+def _relu_layer(x, w, b):
+    return jax.nn.relu(x @ w + b)
+
+
+@op("l2_loss")
+def _l2_loss(x):
+    return 0.5 * jnp.sum(jnp.square(x))
+
+
+@op("log_poisson_loss")
+def _log_poisson_loss(targets, log_input, full=False):
+    loss = jnp.exp(log_input) - targets * log_input
+    if full:
+        loss = loss + targets * jnp.log(jnp.maximum(targets, 1e-12)) - targets \
+            + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(targets, 1e-12))
+    return jnp.mean(loss)
+
+
+@op("separable_conv2d")
+def _separable_conv2d(x, depth_w, point_w, strides=(1, 1), padding="SAME"):
+    """NCHW separable conv: depth_w [C*M,1,KH,KW], point_w [O,C*M,1,1]."""
+    c_in = x.shape[1]
+    z = lax.conv_general_dilated(
+        x, depth_w, strides, padding,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=c_in)
+    return lax.conv_general_dilated(
+        z, point_w, (1, 1), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+# -------------------------------------------------------------- random tail
+
+
+@op("random_multinomial")
+def _random_multinomial(key, logits, num_samples):
+    logits = jnp.asarray(logits)
+    return jax.random.categorical(key, logits[:, None, :],
+                                  shape=(logits.shape[0], num_samples))
+
+
+@op("random_binomial")
+def _random_binomial(key, shape, n=1, p=0.5):
+    return jnp.sum(jax.random.bernoulli(key, p, (n,) + tuple(shape)).astype(jnp.int32), axis=0)
+
+
+@op("random_truncated_normal")
+def _random_truncated_normal(key, shape):
+    return jax.random.truncated_normal(key, -2.0, 2.0, shape)
+
+
+@op("isclose")
+def _isclose(a, b, rtol=1e-5, atol=1e-8):
+    return jnp.isclose(a, b, rtol=rtol, atol=atol)
+
+
+@op("approx_equal")
+def _approx_equal(a, b, tolerance=1e-5):
+    return jnp.abs(a - b) < tolerance

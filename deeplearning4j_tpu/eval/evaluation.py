@@ -325,6 +325,10 @@ class EvaluationCalibration:
         np.add.at(self._conf_sum, idx, conf)
 
     def merge(self, other: "EvaluationCalibration") -> "EvaluationCalibration":
+        if self.bins != other.bins:
+            raise ValueError(
+                f"cannot merge EvaluationCalibration with reliability_bins="
+                f"{other.bins} into one with reliability_bins={self.bins}")
         self._counts += other._counts
         self._correct += other._correct
         self._conf_sum += other._conf_sum

