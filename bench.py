@@ -198,14 +198,103 @@ def _resnet_pipeline_variant(p, step, params, opt, bn, rng, synthetic_ips, steps
         float(loss)
         dt = time.perf_counter() - t0
         ips = batch * (done - 1) / dt
-        return {"images_per_sec": round(ips, 2),
+        jpeg = {"images_per_sec": round(ips, 2),
                 "vs_synthetic": round(ips / synthetic_ips, 3), "steps": done - 1,
-                # ETL is host-CPU-bound: this box's core count is the ceiling
-                # (224x224 JPEG decode ~3ms/core/image); on a real TPU host
-                # (100+ cores) the same pipeline saturates the step
+                # JPEG decode is host-CPU-bound (~3ms/core/image at 224²):
+                # this box's core count is the ceiling for THIS path; the
+                # cached path below is the answer on small hosts
                 "host_cpus": os.cpu_count()}
+        cached = _resnet_pipeline_cached(
+            p, step, params, opt, bn, rng, synthetic_ips, steps, tmp)
+        return {**jpeg, "cached": cached}
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _resnet_pipeline_cached(p, step, params, opt, bn, rng, synthetic_ips,
+                            steps, img_dir):
+    """Pre-decoded uint8 cache path (VERDICT r3 #3): decode once → memmap →
+    vectorized crop/flip on the fly → uint8 NHWC to device, cast/scale/NCHW
+    on-chip. Proves the ETL overlap machinery on a 1-core host."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data import (
+        AsyncDataSetIterator,
+        CachedImageDataSetIterator,
+        PreDecodedImageCache,
+    )
+    from deeplearning4j_tpu.data.records import FileSplit
+
+    batch, hw, classes = p["batch"], p["hw"], p["classes"]
+    t0 = time.perf_counter()
+    cache = PreDecodedImageCache(os.path.join(img_dir, "_u8cache"),
+                                 (hw + 32, hw + 32)).build(
+        FileSplit(img_dir), num_workers=min(16, os.cpu_count() or 8))
+    build_s = time.perf_counter() - t0
+    n_cls = cache.num_labels()
+
+    # device-side ingest fused in front of the train step: uint8 NHWC →
+    # f32 NCHW in [0,1] happens on-chip (4x less host→device traffic)
+    def step_u8(params, opt, bn, it, ep, xu8, y, rng):
+        x = jnp.transpose(xu8, (0, 3, 1, 2)).astype(jnp.float32) / 255.0
+        return step(params, opt, bn, it, ep, {"input": x}, {"output": y}, None, rng)
+
+    jstep = jax.jit(step_u8, donate_argnums=(0, 1, 2))
+    data = AsyncDataSetIterator(
+        CachedImageDataSetIterator(cache, batch, crop=(hw, hw), dtype=np.uint8),
+        queue_size=4)
+    it_j = jnp.asarray(0, jnp.int32)
+    ep_j = jnp.asarray(0, jnp.int32)
+    done = 0
+    t0 = None
+    loss = None
+    while done <= steps:
+        if not data.has_next():
+            data.reset()
+        ds = data.next()
+        if ds.features.shape[0] < batch:
+            continue
+        yb = np.zeros((batch, classes), np.float32)
+        yb[:, :n_cls] = ds.labels[:, :classes]
+        params, opt, bn, loss = jstep(params, opt, bn, it_j, ep_j,
+                                      jnp.asarray(ds.features), jnp.asarray(yb), rng)
+        done += 1
+        if t0 is None:  # first batch warms compile + queue
+            float(loss)
+            t0 = time.perf_counter()
+    float(loss)
+    dt = time.perf_counter() - t0
+    ips = batch * (done - 1) / dt
+
+    # host-only ETL rate (no device): proves whether the input machinery or
+    # the host→device link is the binding constraint
+    host_it = CachedImageDataSetIterator(cache, batch, crop=(hw, hw), dtype=np.uint8)
+    list(host_it)  # warm page cache
+    t0 = time.perf_counter()
+    cnt = 0
+    for _ in range(2):
+        host_it.reset()
+        for ds in host_it:
+            cnt += ds.features.shape[0]
+    host_ips = cnt / (time.perf_counter() - t0)
+
+    # raw H2D bandwidth of one uint8 batch through whatever link exists
+    # (PCIe on a real host; the axon tunnel here)
+    blob = np.zeros((batch, hw, hw, 3), np.uint8)
+    jnp.asarray(blob).block_until_ready()
+    t0 = time.perf_counter()
+    x = jnp.asarray(blob)
+    float(jnp.sum(x[0, 0, 0]))
+    h2d_s = time.perf_counter() - t0
+    h2d_mbps = blob.nbytes / 1e6 / h2d_s
+
+    return {"images_per_sec": round(ips, 2),
+            "vs_synthetic": round(ips / synthetic_ips, 3),
+            "steps": done - 1, "cache_build_s": round(build_s, 2),
+            "host_etl_images_per_sec": round(host_ips, 1),
+            "host_etl_vs_synthetic": round(host_ips / synthetic_ips, 3),
+            "h2d_MBps": round(h2d_mbps, 1)}
 
 
 # --------------------------------------------------------------- lenet (TTA)

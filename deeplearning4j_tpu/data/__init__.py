@@ -19,12 +19,14 @@ from .normalizers import (
     NormalizerStandardize,
 )
 from .image import (
+    CachedImageDataSetIterator,
     ColorJitterTransform,
     CropImageTransform,
     FlipImageTransform,
     ImageRecordReader,
     ImageRecordReaderDataSetIterator,
     ImageTransform,
+    PreDecodedImageCache,
     ParentPathLabelGenerator,
     PipelineImageTransform,
     RandomCropTransform,
@@ -45,8 +47,10 @@ __all__ = [
     "Cifar10DataSetIterator",
     "EmnistDataSetIterator",
     "TinyImageNetDataSetIterator",
+    "CachedImageDataSetIterator",
     "ImageRecordReader",
     "ImageRecordReaderDataSetIterator",
+    "PreDecodedImageCache",
     "ImageTransform",
     "PipelineImageTransform",
     "ParentPathLabelGenerator",

@@ -1,7 +1,9 @@
-"""Keras model import (reference: ``deeplearning4j-modelimport``
+"""Model import (reference: ``deeplearning4j-modelimport``
 ``org.deeplearning4j.nn.modelimport.keras.KerasModelImport`` — SURVEY §2.4
-C13)."""
+C13 — and ``org.nd4j.imports.graphmapper.tf.TFGraphMapper`` — §3.3)."""
 
-from .keras_import import KerasModelImport
+from .keras_import import KerasModelImport, register_custom_layer
+from .tf_import import TFGraphMapper, TFImportError
 
-__all__ = ["KerasModelImport"]
+__all__ = ["KerasModelImport", "TFGraphMapper", "TFImportError",
+           "register_custom_layer"]

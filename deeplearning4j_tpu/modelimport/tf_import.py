@@ -1,0 +1,567 @@
+"""Frozen TensorFlow GraphDef import → SameDiff (SURVEY §3.3, §7.2#7).
+
+Reference: ``org.nd4j.imports.graphmapper.tf.TFGraphMapper.importGraph`` —
+walk a frozen GraphDef, map each node through the op-mapper registry onto
+SameDiff ops, materialize Const nodes as constants. This is the scoped
+BERT-allowlist version the survey plans (~60 TF ops — everything a frozen
+HF/google BERT encoder emits, plus the usual shape-arithmetic tail).
+
+Design difference from the reference: TF passes structural arguments
+(reshape targets, transpose perms, reduction axes) as *tensor* inputs,
+usually Const or computed from ``Shape`` of statically-shaped tensors. The
+reference resolves these case-by-case inside each Java mapper; here the
+walker CONSTANT-FOLDS generically — any node whose inputs are all known
+values executes eagerly through the same op registry at import time, so
+``Shape → StridedSlice → Pack → Reshape`` chains collapse to static shapes
+before the SameDiff graph ever sees them. That keeps the imported graph
+jit-compilable (static shapes, the XLA contract).
+
+TensorFlow is imported ONLY to parse the GraphDef protobuf / tensor
+content (``tf.make_ndarray``); no TF kernels execute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..autodiff.ops_registry import OPS
+from ..autodiff.samediff import SameDiff, SDVariable
+
+
+class TFImportError(ValueError):
+    """Unsupported node / non-constant structural argument."""
+
+
+# TF DataType enum → numpy dtype (the subset frozen inference graphs use)
+_TF_DTYPES = {
+    1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
+    6: np.int8, 9: np.int64, 10: np.bool_, 14: np.float16, 19: np.float16,
+    22: np.uint32, 23: np.uint64,
+}
+
+
+def _np_dtype(enum: int):
+    if enum not in _TF_DTYPES:
+        raise TFImportError(f"unsupported TF dtype enum {enum}")
+    return _TF_DTYPES[enum]
+
+
+class _Ctx:
+    """Walk state: name → value, where a value is a numpy array (known at
+    import time) or an SDVariable (graph tensor); multi-output nodes store
+    tuples."""
+
+    def __init__(self, sd: SameDiff):
+        self.sd = sd
+        self.values: Dict[str, Any] = {}
+        self._uniq = 0
+
+    # -- value access --------------------------------------------------------
+
+    def get(self, ref: str):
+        """Resolve a TF input ref 'name' or 'name:k'."""
+        name, _, idx = ref.partition(":")
+        v = self.values[name]
+        if idx and isinstance(v, tuple):
+            return v[int(idx)]
+        if isinstance(v, tuple):
+            return v[0]
+        return v
+
+    def static(self, ref_value, what: str) -> np.ndarray:
+        """A structural argument must be known at import time (after
+        folding); matches the reference resolving const 'control' inputs."""
+        if isinstance(ref_value, SDVariable):
+            raise TFImportError(
+                f"{what} is not statically known — the source graph computes "
+                "it from a dynamic tensor (re-freeze with static shapes)")
+        return np.asarray(ref_value)
+
+    # -- op application with generic constant folding ------------------------
+
+    def apply(self, op_name: str, *args, n_outputs: int = 1,
+              name: Optional[str] = None, **kwargs):
+        """Run a registry op: eagerly when every tensor arg is a known numpy
+        value (constant folding), else as a SameDiff node. TENSOR arguments
+        are positional; STRUCTURAL/static arguments (shapes, perms, axes,
+        dtypes) must come as kwargs — under jit they stay python values
+        instead of becoming traced constants (the XLA static-shape rule)."""
+        if all(not isinstance(a, SDVariable) for a in args):
+            out = OPS[op_name](*args, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(np.asarray(o) for o in out)
+            return np.asarray(out)
+        lifted = []
+        for a in args:
+            if isinstance(a, SDVariable):
+                lifted.append(a)
+            else:
+                self._uniq += 1
+                lifted.append(self.sd.constant(f"__tfc{self._uniq}", np.asarray(a)))
+        return self.sd.op(op_name, *lifted, n_outputs=n_outputs, name=name,
+                          **kwargs)
+
+
+# --------------------------------------------------------------- op mappers
+# mapper(ctx, inputs, attrs, node_name) -> value (np | SDVariable | tuple)
+
+_MAPPERS: Dict[str, Callable] = {}
+
+
+def _m(*tf_ops):
+    def deco(fn):
+        for op in tf_ops:
+            _MAPPERS[op] = fn
+        return fn
+
+    return deco
+
+
+def _elementwise(registry_name):
+    def fn(ctx, ins, attrs, name):
+        return ctx.apply(registry_name, *ins, name=name)
+
+    return fn
+
+
+for _tf, _reg in {
+    "Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul",
+    "Div": "div", "RealDiv": "realdiv", "FloorDiv": "floordiv",
+    "FloorMod": "floormod", "Maximum": "maximum", "Minimum": "minimum",
+    "Pow": "pow", "SquaredDifference": "squared_difference",
+    "Neg": "neg", "Exp": "exp", "Log": "log", "Sqrt": "sqrt",
+    "Rsqrt": "rsqrt", "Square": "square", "Abs": "abs", "Sign": "sign",
+    "Erf": "erf", "Erfc": "erfc", "Tanh": "tanh", "Sigmoid": "sigmoid",
+    "Relu": "relu", "Relu6": "relu6", "Selu": "selu", "Elu": "elu",
+    "Softplus": "softplus", "Floor": "floor", "Ceil": "ceil",
+    "Round": "round", "Less": "lt", "LessEqual": "lte", "Greater": "gt",
+    "GreaterEqual": "gte", "Equal": "eq", "NotEqual": "neq",
+    "LogicalAnd": "and", "LogicalOr": "or", "LogicalNot": "not",
+    "BiasAdd": "bias_add", "ZerosLike": "zeros_like", "OnesLike": "ones_like",
+    "Reciprocal": "reciprocal",
+}.items():
+    _MAPPERS[_tf] = _elementwise(_reg)
+
+
+@_m("Identity", "StopGradient", "PreventGradient", "CheckNumerics", "EnsureShape")
+def _identity(ctx, ins, attrs, name):
+    return ins[0]
+
+
+@_m("Cast")
+def _cast(ctx, ins, attrs, name):
+    return ctx.apply("cast", ins[0], dtype=_np_dtype(attrs["DstT"].type), name=name)
+
+
+@_m("Reshape")
+def _reshape(ctx, ins, attrs, name):
+    shape = tuple(int(d) for d in ctx.static(ins[1], "Reshape shape"))
+    if isinstance(ins[0], SDVariable) and -1 in shape and ins[0].shape:
+        known = int(np.prod([d for d in shape if d != -1]))
+        total = int(np.prod(ins[0].shape))
+        shape = tuple(total // known if d == -1 else d for d in shape)
+    return ctx.apply("reshape", ins[0], shape=shape, name=name)
+
+
+@_m("Transpose")
+def _transpose(ctx, ins, attrs, name):
+    perm = tuple(int(d) for d in ctx.static(ins[1], "Transpose perm"))
+    return ctx.apply("permute", ins[0], perm=perm, name=name)
+
+
+@_m("ExpandDims")
+def _expand_dims(ctx, ins, attrs, name):
+    axis = int(ctx.static(ins[1], "ExpandDims axis"))
+    return ctx.apply("expand_dims", ins[0], axis=axis, name=name)
+
+
+@_m("Squeeze")
+def _squeeze(ctx, ins, attrs, name):
+    dims = [int(d) for d in attrs["squeeze_dims"].list.i] if "squeeze_dims" in attrs else []
+    x = ins[0]
+    for d in sorted(dims, reverse=True):
+        x = ctx.apply("squeeze", x, axis=d)
+    return x
+
+
+@_m("Shape")
+def _shape(ctx, ins, attrs, name):
+    x = ins[0]
+    if isinstance(x, SDVariable):
+        if x.shape is None or None in x.shape:
+            raise TFImportError(f"Shape of dynamically-shaped tensor {x.name}")
+        return np.asarray(x.shape, np.int64)
+    return np.asarray(np.shape(x), np.int64)
+
+
+@_m("Size")
+def _size(ctx, ins, attrs, name):
+    x = ins[0]
+    shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
+    return np.asarray(int(np.prod(shape)), np.int64)
+
+
+@_m("Rank")
+def _rank(ctx, ins, attrs, name):
+    x = ins[0]
+    shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
+    return np.asarray(len(shape), np.int64)
+
+
+@_m("Fill")
+def _fill(ctx, ins, attrs, name):
+    dims = tuple(int(d) for d in ctx.static(ins[0], "Fill dims"))
+    value = ctx.static(ins[1], "Fill value")
+    return np.full(dims, value)
+
+
+@_m("Range")
+def _range(ctx, ins, attrs, name):
+    start, limit, delta = (ctx.static(i, "Range arg") for i in ins)
+    return np.arange(int(start), int(limit), int(delta))
+
+
+@_m("Pack")
+def _pack(ctx, ins, attrs, name):
+    axis = int(attrs["axis"].i) if "axis" in attrs else 0
+    return ctx.apply("stack", *ins, axis=axis, name=name)
+
+
+@_m("Unpack")
+def _unpack(ctx, ins, attrs, name):
+    axis = int(attrs["axis"].i) if "axis" in attrs else 0
+    num = int(attrs["num"].i)
+    out = ctx.apply("unstack", ins[0], axis=axis, n_outputs=num)
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
+@_m("ConcatV2")
+def _concat(ctx, ins, attrs, name):
+    axis = int(ctx.static(ins[-1], "ConcatV2 axis"))
+    return ctx.apply("concat", *ins[:-1], axis=axis, name=name)
+
+
+@_m("Split")
+def _split(ctx, ins, attrs, name):
+    axis = int(ctx.static(ins[0], "Split axis"))
+    num = int(attrs["num_split"].i)
+    out = ctx.apply("split", ins[1], num=num, axis=axis, n_outputs=num)
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
+@_m("SplitV")
+def _split_v(ctx, ins, attrs, name):
+    sizes = tuple(int(s) for s in ctx.static(ins[1], "SplitV sizes"))
+    axis = int(ctx.static(ins[2], "SplitV axis"))
+    out = ctx.apply("split_v", ins[0], sizes=sizes, axis=axis, n_outputs=len(sizes))
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
+@_m("StridedSlice")
+def _strided_slice(ctx, ins, attrs, name):
+    x = ins[0]
+    begin = np.asarray(ctx.static(ins[1], "StridedSlice begin"), np.int64)
+    end = np.asarray(ctx.static(ins[2], "StridedSlice end"), np.int64)
+    strides = np.asarray(ctx.static(ins[3], "StridedSlice strides"), np.int64)
+    bm = int(attrs["begin_mask"].i) if "begin_mask" in attrs else 0
+    em = int(attrs["end_mask"].i) if "end_mask" in attrs else 0
+    sm = int(attrs["shrink_axis_mask"].i) if "shrink_axis_mask" in attrs else 0
+    nm = int(attrs["new_axis_mask"].i) if "new_axis_mask" in attrs else 0
+    el = int(attrs["ellipsis_mask"].i) if "ellipsis_mask" in attrs else 0
+    if nm or el:
+        raise TFImportError("StridedSlice new_axis/ellipsis masks unsupported")
+    shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
+    if shape is None:
+        raise TFImportError("StridedSlice on shapeless tensor")
+    slices = []
+    shrink = []
+    for d in range(len(begin)):
+        n = shape[d]
+        b, e, s = int(begin[d]), int(end[d]), int(strides[d])
+        if s != 1 and s != -1 and s <= 0:
+            raise TFImportError("StridedSlice stride <= 0 unsupported")
+        if bm & (1 << d):
+            b = 0 if s > 0 else n - 1
+        elif b < 0:
+            b += n
+        if em & (1 << d):
+            e = n if s > 0 else -n - 1
+        elif e < 0:
+            e += n
+        if sm & (1 << d):
+            slices.append((b, b + 1, 1))
+            shrink.append(d)
+        else:
+            slices.append((b, e, s))
+    # registry strided_slice takes positive begin/end/strides tuples
+    begin_t = tuple(s[0] for s in slices)
+    end_t = tuple(s[1] for s in slices)
+    str_t = tuple(s[2] for s in slices)
+    out = ctx.apply("strided_slice", x, begin=begin_t, end=end_t, strides=str_t)
+    for d in sorted(shrink, reverse=True):
+        out = ctx.apply("squeeze", out, axis=d)
+    return out
+
+
+@_m("Slice")
+def _slice(ctx, ins, attrs, name):
+    begin = tuple(int(b) for b in ctx.static(ins[1], "Slice begin"))
+    raw_size = ctx.static(ins[2], "Slice size")
+    x = ins[0]
+    shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
+    size = tuple(shape[d] - begin[d] if int(sz) == -1 else int(sz)
+                 for d, sz in enumerate(raw_size))
+    return ctx.apply("slice", x, begin=begin, size=size, name=name)
+
+
+@_m("Tile")
+def _tile(ctx, ins, attrs, name):
+    reps = tuple(int(r) for r in ctx.static(ins[1], "Tile multiples"))
+    return ctx.apply("tile", ins[0], reps=reps, name=name)
+
+
+@_m("GatherV2")
+def _gather(ctx, ins, attrs, name):
+    axis = int(ctx.static(ins[2], "GatherV2 axis")) if len(ins) > 2 else 0
+    if "batch_dims" in attrs and int(attrs["batch_dims"].i) != 0:
+        raise TFImportError("GatherV2 batch_dims != 0 unsupported")
+    return ctx.apply("gather", ins[0], ins[1], axis=axis, name=name)
+
+
+@_m("OneHot")
+def _one_hot(ctx, ins, attrs, name):
+    depth = int(ctx.static(ins[1], "OneHot depth"))
+    return ctx.apply("one_hot", ins[0], depth=depth, name=name)
+
+
+@_m("BroadcastTo")
+def _broadcast_to(ctx, ins, attrs, name):
+    shape = tuple(int(d) for d in ctx.static(ins[1], "BroadcastTo shape"))
+    return ctx.apply("broadcast_to", ins[0], shape=shape, name=name)
+
+
+@_m("Pad", "PadV2")
+def _pad(ctx, ins, attrs, name):
+    pads = tuple(tuple(int(v) for v in row)
+                 for row in ctx.static(ins[1], "Pad paddings"))
+    return ctx.apply("pad", ins[0], paddings=pads, name=name)
+
+
+@_m("MirrorPad")
+def _mirror_pad(ctx, ins, attrs, name):
+    pads = tuple(tuple(int(v) for v in row)
+                 for row in ctx.static(ins[1], "MirrorPad paddings"))
+    mode = attrs["mode"].s.decode() if "mode" in attrs else "REFLECT"
+    return ctx.apply("mirror_pad", ins[0], paddings=pads, mode=mode, name=name)
+
+
+@_m("MatMul")
+def _matmul(ctx, ins, attrs, name):
+    ta = bool(attrs["transpose_a"].b) if "transpose_a" in attrs else False
+    tb = bool(attrs["transpose_b"].b) if "transpose_b" in attrs else False
+    return ctx.apply("matmul", ins[0], ins[1], transpose_a=ta, transpose_b=tb,
+                     name=name)
+
+
+@_m("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3")
+def _batch_matmul(ctx, ins, attrs, name):
+    adj_x = bool(attrs["adj_x"].b) if "adj_x" in attrs else False
+    adj_y = bool(attrs["adj_y"].b) if "adj_y" in attrs else False
+    a, b = ins[0], ins[1]
+    if adj_x:
+        a = ctx.apply("swapaxes", a, axis1=-2, axis2=-1)
+    if adj_y:
+        b = ctx.apply("swapaxes", b, axis1=-2, axis2=-1)
+    return ctx.apply("matmul", a, b, name=name)
+
+
+def _reduce(registry_name):
+    def fn(ctx, ins, attrs, name):
+        axes = ctx.static(ins[1], "reduction axes")
+        dims = tuple(int(a) for a in np.atleast_1d(axes))
+        keep = bool(attrs["keep_dims"].b) if "keep_dims" in attrs else False
+        return ctx.apply(registry_name, ins[0],
+                         dims=dims if len(dims) > 1 else dims[0],
+                         keepdims=keep, name=name)
+
+    return fn
+
+
+for _tf, _reg in {"Mean": "reduce_mean", "Sum": "reduce_sum",
+                  "Max": "reduce_max", "Min": "reduce_min",
+                  "Prod": "reduce_prod", "All": "reduce_all",
+                  "Any": "reduce_any"}.items():
+    _MAPPERS[_tf] = _reduce(_reg)
+
+
+@_m("ArgMax")
+def _argmax(ctx, ins, attrs, name):
+    axis = int(ctx.static(ins[1], "ArgMax axis")) if len(ins) > 1 else 0
+    return ctx.apply("argmax", ins[0], dims=axis, name=name)
+
+
+@_m("ArgMin")
+def _argmin(ctx, ins, attrs, name):
+    axis = int(ctx.static(ins[1], "ArgMin axis")) if len(ins) > 1 else 0
+    return ctx.apply("argmin", ins[0], dims=axis, name=name)
+
+
+@_m("Softmax")
+def _softmax(ctx, ins, attrs, name):
+    return ctx.apply("softmax", ins[0], name=name)
+
+
+@_m("LogSoftmax")
+def _log_softmax(ctx, ins, attrs, name):
+    return ctx.apply("log_softmax", ins[0], name=name)
+
+
+@_m("Select", "SelectV2")
+def _select(ctx, ins, attrs, name):
+    return ctx.apply("where", ins[0], ins[1], ins[2], name=name)
+
+
+@_m("Einsum")
+def _einsum(ctx, ins, attrs, name):
+    raise TFImportError("Einsum import unsupported (decompose before freezing)")
+
+
+@_m("Assert", "NoOp")
+def _noop(ctx, ins, attrs, name):
+    return np.zeros((), np.bool_)  # control-only; no data consumer
+
+
+# --------------------------------------------------------------- the walker
+
+
+class TFGraphMapper:
+    """``TFGraphMapper.importGraph`` parity for frozen inference graphs."""
+
+    @staticmethod
+    def supported_ops() -> List[str]:
+        return sorted(set(_MAPPERS) | {"Const", "Placeholder", "PlaceholderWithDefault"})
+
+    @staticmethod
+    def import_frozen_graph(path: str, input_shapes: Optional[Dict[str, Tuple]] = None,
+                            outputs: Optional[List[str]] = None) -> "ImportedGraph":
+        """Load a binary GraphDef .pb and import it."""
+        from tensorflow.core.framework import graph_pb2  # proto parse only
+
+        gd = graph_pb2.GraphDef()
+        with open(path, "rb") as f:
+            gd.ParseFromString(f.read())
+        return TFGraphMapper.import_graph(gd, input_shapes, outputs)
+
+    @staticmethod
+    def import_graph(graph_def, input_shapes: Optional[Dict[str, Tuple]] = None,
+                     outputs: Optional[List[str]] = None) -> "ImportedGraph":
+        """graph_def: a tf GraphDef proto (from convert_variables_to_constants_v2
+        or a frozen .pb). Returns an ImportedGraph wrapping the SameDiff."""
+        import tensorflow as tf  # tensor-content parsing (tf.make_ndarray)
+
+        sd = SameDiff.create()
+        ctx = _Ctx(sd)
+        input_shapes = input_shapes or {}
+        placeholders: List[str] = []
+
+        supported = set(_MAPPERS) | {"Const", "Placeholder", "PlaceholderWithDefault"}
+        unknown = sorted({n.op for n in graph_def.node if n.op not in supported})
+        if unknown:
+            raise TFImportError(
+                f"unsupported TF ops in graph: {', '.join(unknown)} "
+                f"(allowlist: {', '.join(TFGraphMapper.supported_ops())})")
+
+        order = _topo_order(graph_def.node)
+
+        for node in order:
+            op = node.op
+            name = node.name
+            attrs = dict(node.attr)
+            if op == "Const":
+                ctx.values[name] = tf.make_ndarray(attrs["value"].tensor)
+                continue
+            if op in ("Placeholder", "PlaceholderWithDefault"):
+                shape = input_shapes.get(name)
+                if shape is None and "shape" in attrs:
+                    dims = [d.size for d in attrs["shape"].shape.dim]
+                    if dims and all(d > 0 for d in dims):
+                        shape = tuple(dims)
+                dtype = _np_dtype(attrs["dtype"].type) if "dtype" in attrs else np.float32
+                ctx.values[name] = sd.placeholder(name, shape=shape, dtype=dtype)
+                placeholders.append(name)
+                continue
+            if op not in _MAPPERS:
+                raise TFImportError(
+                    f"unsupported TF op '{op}' (node {name}); supported: "
+                    f"{', '.join(TFGraphMapper.supported_ops())}")
+            ins = [ctx.get(r) for r in node.input if not r.startswith("^")]
+            ctx.values[name] = _MAPPERS[op](ctx, ins, attrs, None)
+
+        if outputs is None:
+            consumed = set()
+            for n in graph_def.node:
+                for r in n.input:
+                    consumed.add(r.split(":")[0].lstrip("^"))
+            outputs = [n.name for n in graph_def.node
+                       if n.name not in consumed and n.op not in ("Const", "NoOp", "Assert")]
+        return ImportedGraph(sd, ctx, placeholders, outputs)
+
+
+def _topo_order(nodes):
+    by_name = {n.name: n for n in nodes}
+    seen: Dict[str, int] = {}
+    out = []
+
+    def visit(n):
+        state = seen.get(n.name, 0)
+        if state == 2:
+            return
+        if state == 1:
+            raise TFImportError(f"cycle at {n.name}")
+        seen[n.name] = 1
+        for r in n.input:
+            dep = r.split(":")[0].lstrip("^")
+            if dep in by_name:
+                visit(by_name[dep])
+        seen[n.name] = 2
+        out.append(n)
+
+    for n in nodes:
+        visit(n)
+    return out
+
+
+class ImportedGraph:
+    """Executable result: .sd is the SameDiff; output() runs the graph."""
+
+    def __init__(self, sd: SameDiff, ctx: _Ctx, placeholders: List[str],
+                 outputs: List[str]):
+        self.sd = sd
+        self._ctx = ctx
+        self.placeholders = placeholders
+        self.output_names = outputs
+
+    def _resolve(self, name: str):
+        v = self._ctx.get(name)
+        if isinstance(v, SDVariable):
+            return v.name
+        return None  # fully folded to a constant
+
+    def output(self, placeholder_values: Dict[str, Any],
+               outputs: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        names = outputs or self.output_names
+        res: Dict[str, np.ndarray] = {}
+        live = {}
+        for n in names:
+            v = self._ctx.get(n)
+            if isinstance(v, SDVariable):
+                live[n] = v.name
+            else:
+                res[n] = np.asarray(v)
+        if live:
+            got = self.sd.output(placeholder_values, list(live.values()))
+            for tf_name, sd_name in live.items():
+                res[tf_name] = np.asarray(got[sd_name])
+        return res

@@ -1,0 +1,77 @@
+"""Graph-level TF import golden conformance (SURVEY §3.3 / §7.2#7).
+
+A real HF TFBertModel is frozen to a GraphDef and imported node-by-node
+into SameDiff; the imported graph's forward must match TF's own forward
+(the live-golden pattern of test_keras_import). Also covers the generic
+constant-folding of shape-arithmetic subgraphs and the allowlist error.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+transformers = pytest.importorskip("transformers")
+
+from deeplearning4j_tpu.modelimport.tf_import import (  # noqa: E402
+    TFGraphMapper,
+    TFImportError,
+)
+
+
+def _freeze(fn, spec):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    conc = tf.function(fn).get_concrete_function(spec)
+    frozen = convert_variables_to_constants_v2(conc)
+    return frozen.graph.as_graph_def(), [t.name.split(":")[0] for t in frozen.outputs]
+
+
+def test_small_graph_constant_folding():
+    """Shape → StridedSlice → Pack → Reshape chains must fold to static
+    shapes at import time (the XLA static-shape contract)."""
+    def fn(x):
+        s = tf.shape(x)
+        b = s[0]
+        flat = tf.reshape(x, tf.stack([b, -1]))
+        return tf.nn.softmax(flat * 2.0 + 1.0)
+
+    gd, outs = _freeze(fn, tf.TensorSpec([3, 4, 5], tf.float32))
+    g = TFGraphMapper.import_graph(gd, outputs=outs)
+    x = np.random.RandomState(0).randn(3, 4, 5).astype(np.float32)
+    got = g.output({g.placeholders[0]: x})[outs[0]]
+    want = fn(tf.constant(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_bert_frozen_graph_golden():
+    cfg = transformers.BertConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=48, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    model = transformers.TFBertModel(cfg)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    _ = model(tf.constant(ids))  # build weights
+
+    def fwd(input_ids):
+        return model(input_ids).last_hidden_state
+
+    gd, outs = _freeze(fwd, tf.TensorSpec([2, 16], tf.int32))
+    want = fwd(tf.constant(ids)).numpy()
+
+    g = TFGraphMapper.import_graph(gd, outputs=outs)
+    got = g.output({g.placeholders[0]: ids})[outs[0]]
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_unsupported_op_raises_with_allowlist():
+    def fn(x):
+        return tf.signal.fft(tf.cast(x, tf.complex64))
+
+    gd, outs = _freeze(fn, tf.TensorSpec([8], tf.float32))
+    with pytest.raises(TFImportError, match="FFT"):
+        TFGraphMapper.import_graph(gd, outputs=outs)
