@@ -280,12 +280,14 @@ def _resnet_pipeline_cached(p, step, params, opt, bn, rng, synthetic_ips,
     host_ips = cnt / (time.perf_counter() - t0)
 
     # raw H2D bandwidth of one uint8 batch through whatever link exists
-    # (PCIe on a real host; the axon tunnel here)
+    # (PCIe on a real host; the axon tunnel here). Warm both the transfer
+    # and block_until_ready so the timed window holds only the copy — a
+    # compile or sync round trip in-window would bias the number low.
     blob = np.zeros((batch, hw, hw, 3), np.uint8)
+    blob2 = np.ones_like(blob)  # distinct buffer: defeats transfer caching
     jnp.asarray(blob).block_until_ready()
     t0 = time.perf_counter()
-    x = jnp.asarray(blob)
-    float(jnp.sum(x[0, 0, 0]))
+    jnp.asarray(blob2).block_until_ready()
     h2d_s = time.perf_counter() - t0
     h2d_mbps = blob.nbytes / 1e6 / h2d_s
 

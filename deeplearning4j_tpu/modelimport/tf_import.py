@@ -34,11 +34,15 @@ class TFImportError(ValueError):
     """Unsupported node / non-constant structural argument."""
 
 
-# TF DataType enum → numpy dtype (the subset frozen inference graphs use)
+# TF DataType enum → numpy dtype (the subset frozen inference graphs use).
+# DT_BFLOAT16 (14) maps to the real ml_dtypes bfloat16 — float16 would
+# silently change range/precision semantics. DT_HALF is 19.
+import ml_dtypes  # ships with jax
+
 _TF_DTYPES = {
     1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
-    6: np.int8, 9: np.int64, 10: np.bool_, 14: np.float16, 19: np.float16,
-    22: np.uint32, 23: np.uint64,
+    6: np.int8, 9: np.int64, 10: np.bool_, 14: ml_dtypes.bfloat16,
+    19: np.float16, 22: np.uint32, 23: np.uint64,
 }
 
 
@@ -179,8 +183,13 @@ def _expand_dims(ctx, ins, attrs, name):
 
 @_m("Squeeze")
 def _squeeze(ctx, ins, attrs, name):
-    dims = [int(d) for d in attrs["squeeze_dims"].list.i] if "squeeze_dims" in attrs else []
     x = ins[0]
+    dims = [int(d) for d in attrs["squeeze_dims"].list.i] if "squeeze_dims" in attrs else []
+    if not dims:  # TF semantics: no axis attr = squeeze ALL size-1 dims
+        shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
+        if shape is None:
+            raise TFImportError("Squeeze without dims on shapeless tensor")
+        dims = [d for d, n in enumerate(shape) if n == 1]
     for d in sorted(dims, reverse=True):
         x = ctx.apply("squeeze", x, axis=d)
     return x
@@ -510,26 +519,32 @@ class TFGraphMapper:
 
 
 def _topo_order(nodes):
+    """Iterative DFS — frozen BERT-base graphs chain thousands of nodes,
+    far past Python's recursion limit."""
     by_name = {n.name: n for n in nodes}
     seen: Dict[str, int] = {}
     out = []
-
-    def visit(n):
-        state = seen.get(n.name, 0)
-        if state == 2:
-            return
-        if state == 1:
-            raise TFImportError(f"cycle at {n.name}")
-        seen[n.name] = 1
-        for r in n.input:
-            dep = r.split(":")[0].lstrip("^")
-            if dep in by_name:
-                visit(by_name[dep])
-        seen[n.name] = 2
-        out.append(n)
-
-    for n in nodes:
-        visit(n)
+    for root in nodes:
+        if seen.get(root.name):
+            continue
+        stack = [(root, False)]
+        while stack:
+            n, expanded = stack.pop()
+            if expanded:
+                seen[n.name] = 2
+                out.append(n)
+                continue
+            state = seen.get(n.name, 0)
+            if state == 2:
+                continue
+            if state == 1:
+                raise TFImportError(f"cycle at {n.name}")
+            seen[n.name] = 1
+            stack.append((n, True))
+            for r in n.input:
+                dep = r.split(":")[0].lstrip("^")
+                if dep in by_name and seen.get(by_name[dep].name, 0) == 0:
+                    stack.append((by_name[dep], False))
     return out
 
 
