@@ -19,7 +19,8 @@ from .transformer import (
 )
 from .zoo import LeNet, SimpleCNN, ZooModel
 from .resnet import ResNet50
-from .vgg import VGG16
+from .facenet import InceptionResNetV1
+from .vgg import VGG16, VGG19
 from .text_lstm import TextGenerationLSTM
 from .zoo_ext import AlexNet, Darknet19, SqueezeNet, UNet, Xception
 from .moe import MoEConfig, init_moe_params, moe_ffn, moe_partition_specs
@@ -40,5 +41,7 @@ __all__ = [
     "SimpleCNN",
     "ResNet50",
     "VGG16",
+    "VGG19",
+    "InceptionResNetV1",
     "TextGenerationLSTM",
 ]

@@ -1,7 +1,7 @@
-"""VGG-16 zoo model.
+"""VGG-16 / VGG-19 zoo models.
 
-Reference: ``org.deeplearning4j.zoo.model.VGG16`` (SURVEY §2.4 C15) — 13 conv
-layers in 5 blocks + 2 FC(4096) + softmax(1000).
+Reference: ``org.deeplearning4j.zoo.model.VGG16`` / ``VGG19`` (SURVEY §2.4
+C15) — 13/16 conv layers in 5 blocks + 2 FC(4096) + softmax(1000).
 """
 
 from __future__ import annotations
@@ -22,6 +22,8 @@ from .zoo import ZooModel
 
 
 class VGG16(ZooModel):
+    BLOCKS = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
     def __init__(self, num_classes: int = 1000, seed: int = 123,
                  input_shape: Tuple[int, int, int] = (3, 224, 224)):
         self.num_classes = num_classes
@@ -37,7 +39,7 @@ class VGG16(ZooModel):
             .weight_init("relu")
             .list()
         )
-        for n_convs, n_out in ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)):
+        for n_convs, n_out in self.BLOCKS:
             for _ in range(n_convs):
                 b = b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
                                              convolution_mode="same", activation="relu"))
@@ -52,3 +54,10 @@ class VGG16(ZooModel):
             .set_input_type(InputType.convolutional(h, w, c))
             .build()
         )
+
+
+class VGG19(VGG16):
+    """org.deeplearning4j.zoo.model.VGG19: the last three blocks grow to 4
+    convolutions; everything else inherits from VGG16."""
+
+    BLOCKS = ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512))

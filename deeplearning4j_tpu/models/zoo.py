@@ -42,14 +42,45 @@ class ZooModel:
     def pretrained_url(self, dataset: str = "imagenet") -> Optional[str]:
         return None  # zero-egress build: no download URLs
 
-    def init_pretrained(self, path: Optional[str] = None):
+    def pretrained_checksum(self, dataset: str = "imagenet") -> Optional[str]:
+        """sha256 hex the checkpoint must match (``ZooModel.pretrainedChecksum``
+        analog; the reference uses adler32 over the download)."""
+        return None
+
+    def init_pretrained(self, path: Optional[str] = None,
+                        dataset: str = "imagenet",
+                        checksum: Optional[str] = None):
+        """Load pretrained weights from a LOCAL checkpoint zip, verifying its
+        sha256 when a checksum is supplied (or published by the model class).
+
+        The reference's ``initPretrained()`` downloads from ``pretrainedUrl``
+        and verifies a checksum; this build runs with zero egress (documented
+        exclusion in README), so the file must already be on disk — the API
+        shape (dataset selector + checksum verification) is kept."""
         if path is None:
+            url = self.pretrained_url(dataset)
             raise ValueError(
-                "no pretrained weights available in this environment; pass a "
-                "local checkpoint path (ModelSerializer zip)")
+                "no pretrained weights can be downloaded in this environment"
+                + (f" (reference URL would be {url})" if url else "")
+                + "; pass a local checkpoint path (ModelSerializer zip)")
+        want = checksum or self.pretrained_checksum(dataset)
+        if want is not None:
+            import hashlib
+
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            got = h.hexdigest()
+            if got != want.lower():
+                raise ValueError(
+                    f"pretrained checkpoint checksum mismatch for {path}: "
+                    f"sha256 {got} != expected {want} (corrupt or wrong file)")
         from ..serde.model_serializer import ModelSerializer
 
         return ModelSerializer.restore(path)
+
+    initPretrained = init_pretrained
 
 
 class LeNet(ZooModel):

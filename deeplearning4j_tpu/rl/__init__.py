@@ -9,6 +9,12 @@ the sync DQN path covers the QLearning baseline.
 
 from .mdp import MDP, DiscreteSpace, ObservationSpace
 from .envs import CartPoleEnv, GymEnvAdapter
+from .history import (
+    AsyncNStepQLearningDiscrete,
+    AsyncQLearningConfiguration,
+    HistoryProcessor,
+    HistoryProcessorConfiguration,
+)
 from .qlearning import DQNFactoryStdDense, DQNPolicy, ExpReplay, QLearningConfiguration, QLearningDiscrete
 
 __all__ = [

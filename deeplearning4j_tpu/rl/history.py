@@ -1,0 +1,247 @@
+"""HistoryProcessor + async n-step Q-learning (SURVEY §2.7 R1 tail).
+
+Reference: ``org.deeplearning4j.rl4j.util.HistoryProcessor`` (frame
+skip/stack/scale/crop for pixel observations — the DQN-on-Atari
+preprocessing) and ``rl4j-core``'s ``AsyncNStepQLearningDiscrete`` (Mnih
+2016 asynchronous n-step Q-learning: worker threads each roll out n steps,
+compute n-step targets against a shared target network, and apply gradients
+to the shared online network).
+
+TPU-native shape: the reference's async workers exist to parallelize the
+ENV (cheap CPU rollouts) against a GPU learner; that split is kept —
+python threads collect rollouts (env steps release the GIL through numpy)
+while every gradient application is the same single compiled XLA step,
+serialized through a lock exactly like the reference's shared
+AsyncGlobal.applyGradient.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .mdp import MDP
+from .qlearning import DQNFactoryStdDense, QLearningConfiguration
+
+
+@dataclass
+class HistoryProcessorConfiguration:
+    """rl4j HistoryProcessor.Configuration parity."""
+
+    history_length: int = 4
+    rescaled_width: int = 84
+    rescaled_height: int = 84
+    cropping_width: int = 84
+    cropping_height: int = 84
+    offset_x: int = 0
+    offset_y: int = 0
+    skip_frame: int = 4
+
+
+class HistoryProcessor:
+    """Frame pipeline: grayscale → rescale → crop → stack last k frames,
+    recording every ``skip_frame``-th frame (others repeat the last stack).
+
+    ``record(frame)`` takes HWC uint8/float [H,W,3] or [H,W]; ``history()``
+    returns [k, h, w] float32 in [0,1] (the reference returns the stacked
+    INDArray the DQN consumes).
+    """
+
+    def __init__(self, conf: Optional[HistoryProcessorConfiguration] = None):
+        self.conf = conf or HistoryProcessorConfiguration()
+        self._frames: List[np.ndarray] = []
+        self._step = 0
+
+    def _preprocess(self, frame: np.ndarray) -> np.ndarray:
+        # scaling decided by DTYPE, not content: a near-black uint8 frame
+        # must scale identically to a bright one in the same stack
+        is_int = np.issubdtype(np.asarray(frame).dtype, np.integer)
+        f = np.asarray(frame, np.float32)
+        if f.ndim == 3:  # BT.601 luma, matching the reference's grayscale
+            f = f @ np.asarray([0.299, 0.587, 0.114], np.float32)
+        if is_int:
+            f = f / 255.0
+        c = self.conf
+        if f.shape != (c.rescaled_height, c.rescaled_width):
+            f = self._rescale(f, c.rescaled_height, c.rescaled_width)
+        return f[c.offset_y:c.offset_y + c.cropping_height,
+                 c.offset_x:c.offset_x + c.cropping_width]
+
+    @staticmethod
+    def _rescale(f: np.ndarray, h: int, w: int) -> np.ndarray:
+        """Nearest-neighbor resize (no PIL dependency in the RL hot loop)."""
+        ys = (np.arange(h) * f.shape[0] / h).astype(np.int32)
+        xs = (np.arange(w) * f.shape[1] / w).astype(np.int32)
+        return f[ys][:, xs]
+
+    def record(self, frame: np.ndarray) -> bool:
+        """Returns True when this frame was added (i.e. a skip boundary)."""
+        take = self._step % self.conf.skip_frame == 0
+        self._step += 1
+        if take:
+            self._frames.append(self._preprocess(frame))
+            if len(self._frames) > self.conf.history_length:
+                self._frames.pop(0)
+        return take
+
+    def start(self, frame: np.ndarray):
+        """Reset and fill the stack with the initial frame (episode start)."""
+        self._frames = [self._preprocess(frame)] * self.conf.history_length
+        self._step = 1
+
+    def history(self) -> np.ndarray:
+        k = self.conf.history_length
+        frames = ([self._frames[0]] * (k - len(self._frames)) + self._frames
+                  if self._frames else
+                  [np.zeros((self.conf.cropping_height, self.conf.cropping_width),
+                            np.float32)] * k)
+        return np.stack(frames[-k:])
+
+    getHistory = history
+
+
+@dataclass
+class AsyncQLearningConfiguration(QLearningConfiguration):
+    """rl4j AsyncQLearningConfiguration: adds n-step + worker count."""
+
+    n_step: int = 5
+    num_threads: int = 2
+
+
+class AsyncNStepQLearningDiscrete:
+    """rl4j ``AsyncNStepQLearningDiscrete``: each worker thread rolls out up
+    to ``n_step`` transitions, bootstraps G = r_t + γ r_{t+1} + … + γ^n
+    max_a Q_target(s', a), and applies one gradient step on the SHARED
+    online network; the target network refreshes every
+    ``target_dqn_update_freq`` global steps."""
+
+    def __init__(self, mdp_factory: Callable[[int], MDP],
+                 config: Optional[AsyncQLearningConfiguration] = None,
+                 hidden: int = 64):
+        self.cfg = config or AsyncQLearningConfiguration()
+        self.mdp_factory = mdp_factory
+        probe = mdp_factory(0)
+        n_in = int(np.prod(probe.observation_space.shape))
+        self.n_act = probe.action_space.size
+        probe.close()
+        self.qnet = DQNFactoryStdDense.build(n_in, self.n_act, hidden=hidden,
+                                             seed=self.cfg.seed)
+        self.target_params = jax.tree.map(jnp.copy, self.qnet.params_)
+        self._lock = threading.Lock()
+        self.global_steps = 0
+        self.epoch_rewards: List[float] = []
+        self._jit = None
+
+    # -------------------------------------------------------------- train op
+
+    def _train_step(self):
+        if self._jit is not None:
+            return self._jit
+        net = self.qnet
+        updater = net.conf.updater
+
+        def q_values(params, x):
+            h, _, _ = net._forward(params, net.bn_state, x, training=False, rng=None)
+            i = len(net.conf.layers) - 1
+            layer = net.conf.layers[i]
+            return layer.forward(params.get(str(i), {}), h, net._input_types[i],
+                                 training=False, rng=None)
+
+        def step(params, upd_state, iteration, s, a, g):
+            def loss_fn(p):
+                q = q_values(p, s)
+                qa = jnp.take_along_axis(q, a[:, None], 1)[:, 0]
+                return jnp.mean(jnp.square(qa - g))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, new_upd = updater.apply(grads, upd_state, params, iteration, 0)
+            return jax.tree.map(lambda p, u: p - u, params, updates), new_upd, loss
+
+        self._q_values = q_values
+        # NO buffer donation here: other worker threads hold references to
+        # the shared online params as their rollout snapshot — donating
+        # would delete buffers out from under them mid-rollout
+        self._jit = jax.jit(step)
+        return self._jit
+
+    # -------------------------------------------------------------- rollout
+
+    def _worker(self, tid: int):
+        cfg = self.cfg
+        mdp = self.mdp_factory(tid)
+        rs = np.random.RandomState(cfg.seed + 1000 * (tid + 1))
+        step_fn = self._train_step()
+        obs = mdp.reset().reshape(-1)
+        ep_reward = 0.0
+        while self.global_steps < cfg.max_step:
+            # n-step rollout against a params snapshot
+            with self._lock:
+                params_snap = self.qnet.params_
+            traj: List[Tuple[np.ndarray, int, float]] = []
+            done = False
+            for _ in range(cfg.n_step):
+                frac = min(1.0, self.global_steps / max(1, cfg.eps_anneal_steps))
+                eps = 1.0 + frac * (cfg.min_epsilon - 1.0)
+                if rs.rand() < eps:
+                    a = mdp.action_space.random_action(rs)
+                else:
+                    q = np.asarray(self._q_values(params_snap, jnp.asarray(obs[None])))
+                    a = int(np.argmax(q[0]))
+                obs2, r, done, _ = mdp.step(a)
+                traj.append((obs, a, r * cfg.reward_factor))
+                ep_reward += r
+                obs = obs2.reshape(-1)
+                with self._lock:
+                    self.global_steps += 1
+                if done:
+                    break
+            # n-step returns, bootstrapped from the target net unless done
+            if done:
+                g = 0.0
+            else:
+                q_next = np.asarray(self._q_values(self.target_params,
+                                                   jnp.asarray(obs[None])))
+                g = float(np.max(q_next[0]))
+            gs = []
+            for (_, _, r) in reversed(traj):
+                g = r + cfg.gamma * g
+                gs.append(g)
+            gs.reverse()
+            s_b = np.stack([t[0] for t in traj]).astype(np.float32)
+            a_b = np.asarray([t[1] for t in traj], np.int32)
+            g_b = np.asarray(gs, np.float32)
+            with self._lock:  # AsyncGlobal.applyGradient: serialized apply
+                self.qnet.params_, self.qnet.updater_state, _ = step_fn(
+                    self.qnet.params_, self.qnet.updater_state,
+                    jnp.asarray(self.qnet.iteration, jnp.int32),
+                    jnp.asarray(s_b), jnp.asarray(a_b), jnp.asarray(g_b))
+                self.qnet.iteration += 1
+                if self.global_steps % cfg.target_dqn_update_freq < cfg.n_step:
+                    self.target_params = jax.tree.map(jnp.copy, self.qnet.params_)
+            if done:
+                with self._lock:
+                    self.epoch_rewards.append(ep_reward)
+                ep_reward = 0.0
+                obs = mdp.reset().reshape(-1)
+        mdp.close()
+
+    def train(self) -> List[float]:
+        self._train_step()  # compile once before threads race
+        threads = [threading.Thread(target=self._worker, args=(t,), daemon=True)
+                   for t in range(self.cfg.num_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self.epoch_rewards
+
+    def get_policy(self):
+        from .qlearning import DQNPolicy
+
+        return DQNPolicy(self.qnet)

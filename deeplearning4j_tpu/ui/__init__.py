@@ -8,7 +8,8 @@ them. The storage-decoupled-from-server design is kept (SURVEY calls it
 good); SBE encoding + Vert.x become JSON lines + http.server.
 """
 
-from .stats import FileStatsStorage, InMemoryStatsStorage, StatsListener
+from .stats import (FileStatsStorage, InMemoryStatsStorage,
+                    RemoteUIStatsStorageRouter, StatsListener)
 from .server import UIServer
 from .profiling import ProfilingListener
 

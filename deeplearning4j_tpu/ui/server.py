@@ -118,6 +118,30 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._json({"error": "not found"}, 404)
 
+    def do_POST(self):
+        # remote stats collection endpoint (ref:
+        # org.deeplearning4j.ui.model.storage.impl.RemoteUIStatsStorageRouter →
+        # VertxUIServer's /remoteReceive): a training process on another host
+        # POSTs its stats records here; they land in the same StatsStorage
+        # the dashboard reads
+        if self.path in ("/remoteReceive", "/collect"):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n).decode())
+                records = payload if isinstance(payload, list) else [payload]
+                if not all(isinstance(r, dict) for r in records):
+                    # a non-dict record would poison the storage and 500
+                    # every later dashboard read
+                    self._json({"ok": False, "error": "records must be JSON objects"}, 400)
+                    return
+                for rec in records:
+                    self.storage.put_record(rec)
+                self._json({"ok": True, "received": len(records)})
+            except Exception as e:  # malformed remote payload must not kill the UI
+                self._json({"ok": False, "error": str(e)}, 400)
+            return
+        self._json({"error": "not found"}, 404)
+
 
 def model_graph_json(net) -> dict:
     """Topology descriptor for the model tab (VertxUIServer's model-graph

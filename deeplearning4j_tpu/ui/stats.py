@@ -142,3 +142,50 @@ class StatsListener:
 
     def on_epoch_end(self, model) -> None:
         return None
+
+
+class RemoteUIStatsStorageRouter(StatsStorage):
+    """Route stats records to a remote UIServer over HTTP (reference:
+    ``org.deeplearning4j.ui.model.storage.impl.RemoteUIStatsStorageRouter``):
+    a trainer on one host POSTs to the dashboard host's ``/remoteReceive``.
+    Failed posts are retried with backoff up to ``retry_count`` and then
+    dropped with a warning — stats routing must never stall training
+    (the reference behaves the same way)."""
+
+    def __init__(self, address: str, retry_count: int = 3,
+                 retry_backoff_ms: int = 100):
+        self.address = address.rstrip("/")
+        self.retry_count = retry_count
+        self.retry_backoff_ms = retry_backoff_ms
+        self.dropped = 0
+
+    def put_record(self, record: Dict[str, Any]) -> None:
+        import json as _json
+        import time as _time
+        import urllib.request
+
+        body = _json.dumps(record).encode()
+        req = urllib.request.Request(
+            self.address + "/remoteReceive", data=body,
+            headers={"Content-Type": "application/json"})
+        for attempt in range(self.retry_count):
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    resp.read()
+                return
+            except Exception:
+                if attempt < self.retry_count - 1:  # no pointless final sleep
+                    _time.sleep(self.retry_backoff_ms / 1000.0 * (attempt + 1))
+        self.dropped += 1
+        import warnings
+
+        warnings.warn(
+            f"RemoteUIStatsStorageRouter: dropped a stats record after "
+            f"{self.retry_count} attempts to {self.address} "
+            f"({self.dropped} dropped total)", stacklevel=2)
+
+    def records(self, session_id=None):
+        raise NotImplementedError("router is write-only; read on the UI host")
+
+    def session_ids(self):
+        raise NotImplementedError("router is write-only; read on the UI host")

@@ -174,3 +174,53 @@ def ckpt_train():
                   "param_sum": float(flat.sum()),
                   "param_norm": float(np.linalg.norm(flat)),
                   "start": start})
+
+
+def w2v_shard_train():
+    """Cross-process embedding-shard training (SURVEY §2.2 J17 / §2.6 S6):
+    syn0/syn1 rows shard over a GLOBAL mesh spanning both processes; the
+    epoch executable's gathers/updates compile to cross-process collectives.
+    Each rank writes table hashes (cross-process row sync) + a semantic
+    check (co-occurring words more similar than non-co-occurring)."""
+    import hashlib
+
+    import jax
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+
+    col = ProcessCollectives()
+    rank = col.rank
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("model",))
+
+    # two word clusters that never co-occur: 64 words → V=64 divides the
+    # 8-device axis, so the tables genuinely shard 8 ways across processes
+    rs = np.random.RandomState(0)
+    a_words = [f"a{i}" for i in range(32)]
+    b_words = [f"b{i}" for i in range(32)]
+    sents = []
+    for _ in range(400):
+        sents.append(" ".join(rs.choice(a_words, 6)))
+        sents.append(" ".join(rs.choice(b_words, 6)))
+
+    w2v = Word2Vec(layer_size=16, window=3, negative=4, epochs=20,
+                   learning_rate=0.05, batch_size=256, min_word_frequency=1,
+                   seed=3, subsampling=0.0, mesh=mesh)
+    w2v.fit(sents)
+
+    def sim(u, v):
+        u, v = w2v.get_word_vector(u), w2v.get_word_vector(v)
+        return float(u @ v / (np.linalg.norm(u) * np.linalg.norm(v) + 1e-9))
+
+    within = np.mean([sim(f"a{i}", f"a{i+1}") for i in range(0, 30, 2)]
+                     + [sim(f"b{i}", f"b{i+1}") for i in range(0, 30, 2)])
+    across = np.mean([sim(f"a{i}", f"b{i}") for i in range(0, 32, 2)])
+    col.barrier("w2v-done")
+    _write(rank, {
+        "syn0_hash": hashlib.sha256(np.ascontiguousarray(w2v.syn0)).hexdigest(),
+        "syn1_hash": hashlib.sha256(np.ascontiguousarray(w2v.syn1neg)).hexdigest(),
+        "within": float(within), "across": float(across),
+        "vocab": w2v.vocab.num_words(),
+        "global_devices": jax.device_count(),
+    })

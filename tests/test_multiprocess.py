@@ -149,3 +149,17 @@ def test_kill_one_process_restore_from_checkpoint(tmp_path):
     np.testing.assert_allclose(resumed["param_sum"], base["param_sum"],
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(resumed["param_norm"], base["param_norm"], rtol=1e-5)
+
+
+def test_w2v_embedding_shards_across_processes(tmp_path):
+    """Cross-process embedding-shard training (VERDICT r3 missing #6): the
+    w2v tables shard over a global 2-process × 4-device mesh; after fit the
+    read-back tables are identical on both ranks (row sync through the
+    compiled collectives) and the embeddings are semantically sane."""
+    r0, r1 = _run("w2v_shard_train", tmp_path, n=2, dev=4, timeout=600)
+    assert r0["global_devices"] == 8
+    assert r0["vocab"] == 64                       # divides the 8-way axis
+    assert r0["syn0_hash"] == r1["syn0_hash"]      # shards re-synced identically
+    assert r0["syn1_hash"] == r1["syn1_hash"]
+    # words that co-occur must embed closer than words that never do
+    assert r0["within"] > r0["across"] + 0.1, (r0["within"], r0["across"])

@@ -30,3 +30,58 @@ def test_dqn_learns_chain_mdp():
     assert total > 9.0, total
     # epsilon annealed
     assert abs(learner.epsilon() - cfg.min_epsilon) < 1e-6
+
+
+class TestHistoryProcessor:
+    def test_stack_skip_scale(self):
+        from deeplearning4j_tpu.rl import HistoryProcessor, HistoryProcessorConfiguration
+
+        hp = HistoryProcessor(HistoryProcessorConfiguration(
+            history_length=3, rescaled_width=8, rescaled_height=8,
+            cropping_width=6, cropping_height=6, offset_x=1, offset_y=1,
+            skip_frame=2))
+        f0 = np.full((16, 16, 3), 255, np.uint8)
+        hp.start(f0)
+        h = hp.history()
+        assert h.shape == (3, 6, 6)
+        np.testing.assert_allclose(h, 1.0)          # scaled to [0,1]
+        # skip_frame=2: frame 1 skipped, frame 2 recorded
+        assert not hp.record(np.zeros((16, 16, 3), np.uint8))
+        assert hp.record(np.zeros((16, 16, 3), np.uint8))
+        h = hp.history()
+        np.testing.assert_allclose(h[-1], 0.0)      # newest is the dark frame
+        np.testing.assert_allclose(h[0], 1.0)       # oldest still bright
+
+    def test_grayscale_luma(self):
+        from deeplearning4j_tpu.rl import HistoryProcessor, HistoryProcessorConfiguration
+
+        hp = HistoryProcessor(HistoryProcessorConfiguration(
+            history_length=1, rescaled_width=4, rescaled_height=4,
+            cropping_width=4, cropping_height=4, skip_frame=1))
+        f = np.zeros((4, 4, 3), np.float32)
+        f[..., 1] = 1.0  # pure green
+        hp.start(f)
+        np.testing.assert_allclose(hp.history()[0], 0.587, rtol=1e-5)
+
+
+class TestAsyncNStep:
+    def test_learns_toy_mdp(self):
+        from deeplearning4j_tpu.rl import (
+            AsyncNStepQLearningDiscrete,
+            AsyncQLearningConfiguration,
+        )
+        from deeplearning4j_tpu.rl.mdp import SimpleToyMDP
+
+        cfg = AsyncQLearningConfiguration(
+            max_step=3000, n_step=5, num_threads=2, eps_anneal_steps=1500,
+            target_dqn_update_freq=50, seed=5)
+        ql = AsyncNStepQLearningDiscrete(lambda tid: SimpleToyMDP(n=5), cfg,
+                                        hidden=32)
+        ql.train()
+        # workers must SURVIVE to max_step (a crashed worker leaves
+        # global_steps short — the donation bug regression guard)
+        assert ql.global_steps >= cfg.max_step, ql.global_steps
+        assert len(ql.epoch_rewards) > 5
+        # greedy policy must solve the chain (always-right = ~+10)
+        score = ql.get_policy().play(SimpleToyMDP(n=5))
+        assert score > 9.0, score
