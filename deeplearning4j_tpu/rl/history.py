@@ -148,10 +148,7 @@ class AsyncNStepQLearningDiscrete:
 
         def q_values(params, x):
             h, _, _ = net._forward(params, net.bn_state, x, training=False, rng=None)
-            i = len(net.conf.layers) - 1
-            layer = net.conf.layers[i]
-            return layer.forward(params.get(str(i), {}), h, net._input_types[i],
-                                 training=False, rng=None)
+            return net._head_forward(params, h)
 
         def step(params, upd_state, iteration, s, a, g):
             def loss_fn(p):
@@ -163,7 +160,9 @@ class AsyncNStepQLearningDiscrete:
             updates, new_upd = updater.apply(grads, upd_state, params, iteration, 0)
             return jax.tree.map(lambda p, u: p - u, params, updates), new_upd, loss
 
-        self._q_values = q_values
+        # jitted: action selection + n-step bootstrap run in the worker hot
+        # loop — eager per-op dispatch there would dominate the step time
+        self._q_values = jax.jit(q_values)
         # NO buffer donation here: other worker threads hold references to
         # the shared online params as their rollout snapshot — donating
         # would delete buffers out from under them mid-rollout

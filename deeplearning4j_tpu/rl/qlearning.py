@@ -109,10 +109,7 @@ class QLearningDiscrete:
 
         def q_values(params, x):
             h, _, _ = net._forward(params, net.bn_state, x, training=False, rng=None)
-            i = len(net.conf.layers) - 1
-            layer = net.conf.layers[i]
-            return layer.forward(params.get(str(i), {}), h, net._input_types[i],
-                                 training=False, rng=None)
+            return net._head_forward(params, h)
 
         def step(params, target_params, upd_state, iteration, s, a, r, s2, done):
             q_next_t = q_values(target_params, s2)

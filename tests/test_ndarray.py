@@ -355,3 +355,65 @@ def test_memory_workspace_facade():
     import numpy as np
     a = nd.ones(3)
     np.testing.assert_array_equal(a.detach().numpy(), a.numpy())
+
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+
+
+class TestJ1Wave3:
+    """J1 breadth wave 3: distances, order stats, layout accessors,
+    BooleanIndexing-style conditionals, and the Transforms static API."""
+
+    def test_distances(self):
+        a = NDArray(np.array([1.0, 2.0, 3.0], np.float32))
+        b = NDArray(np.array([1.0, 0.0, 5.0], np.float32))
+        assert a.distance1(b) == 4.0
+        np.testing.assert_allclose(a.distance2(b), np.sqrt(8.0), rtol=1e-6)
+        assert a.squared_distance(b) == 8.0
+
+    def test_order_stats(self):
+        a = NDArray(np.array([5.0, 1.0, 3.0, 2.0, 4.0], np.float32))
+        assert a.median_number() == 3.0
+        assert a.percentile_number(100) == 5.0
+
+    def test_stride_offset_slice_element(self):
+        a = NDArray(np.arange(12.0, dtype=np.float32).reshape(3, 4))
+        assert a.stride() == (4, 1)
+        assert NDArray(np.zeros((3, 4), np.float32), order="f").stride() == (1, 3)
+        assert a.offset() == 0
+        row = a.slice(1)
+        np.testing.assert_allclose(row.numpy(), [4, 5, 6, 7])
+        col = a.slice(2, dim=1)
+        np.testing.assert_allclose(col.numpy(), [2, 6, 10])
+        assert NDArray(np.array([[7.0]], np.float32)).element() == 7.0
+
+    def test_boolean_indexing(self):
+        a = NDArray(np.array([-2.0, 3.0, -1.0, 4.0], np.float32))
+        mask = a.match_condition(lambda x: x < 0)
+        np.testing.assert_array_equal(mask.numpy(), [True, False, True, False])
+        a.replace_where(0.0, lambda x: x < 0)
+        np.testing.assert_allclose(a.numpy(), [0, 3, 0, 4])
+        got = a.get_where(np.array([1.0, -1.0, 1.0, -1.0]), lambda x: x > 0)
+        np.testing.assert_allclose(got.numpy(), [0, 0])
+
+    def test_transforms_api(self):
+        from deeplearning4j_tpu.ndarray import transforms as T
+
+        a = NDArray(np.array([1.0, 4.0, 9.0], np.float32))
+        np.testing.assert_allclose(T.sqrt(a).numpy(), [1, 2, 3], rtol=1e-6)
+        np.testing.assert_allclose(T.sigmoid(NDArray(np.zeros(2, np.float32))).numpy(), 0.5)
+        # dup=False writes through
+        b = NDArray(np.array([1.0, 2.0], np.float32))
+        out = T.exp(b, dup=False)
+        assert out is b
+        np.testing.assert_allclose(b.numpy(), np.exp([1.0, 2.0]), rtol=1e-6)
+        u = T.unit_vec(np.array([3.0, 4.0]))
+        np.testing.assert_allclose(u.numpy(), [0.6, 0.8], rtol=1e-6)
+        assert abs(T.cosine_sim([1.0, 0.0], [0.0, 1.0])) < 1e-6
+        assert T.euclidean_distance([0.0, 0.0], [3.0, 4.0]) == 5.0
+        m = T.is_max(np.array([[1.0, 9.0], [3.0, 2.0]]))
+        np.testing.assert_allclose(m.numpy(), [[0, 1], [0, 0]])
+        s = T.softmax(np.array([[0.0, 0.0]]))
+        np.testing.assert_allclose(s.numpy(), [[0.5, 0.5]])
+        np.testing.assert_allclose(
+            T.sort(np.array([3.0, 1.0, 2.0]), descending=True).numpy(), [3, 2, 1])

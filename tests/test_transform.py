@@ -1,0 +1,168 @@
+"""Transform-pipeline depth (VERDICT r3 missing #5): reductions, sequence
+ops, dual-column math, conditional copy, quality analysis."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data.transform import Schema, TransformProcess
+
+
+
+# ------------------------------------------------------- D2 depth (wave 3)
+
+
+def _sales_schema():
+    return (Schema.Builder()
+            .add_column_string("store")
+            .add_column_double("amount")
+            .add_column_double("qty")
+            .add_column_integer("t")
+            .build())
+
+
+_SALES = [
+    ["a", 10.0, 1.0, 0], ["a", 20.0, 2.0, 1], ["a", 30.0, 3.0, 2],
+    ["b", 5.0, 1.0, 0], ["b", 7.0, 1.0, 1],
+]
+
+
+class TestReductions:
+    def test_reduce_group_by(self):
+        from deeplearning4j_tpu.data import Reducer
+
+        red = (Reducer.Builder("store")
+               .sum_columns("amount")
+               .mean_columns("qty")
+               .count_columns("t")
+               .build())
+        tp = (TransformProcess.Builder(_sales_schema())
+              .reduce(red)
+              .build())
+        out = tp.execute([list(r) for r in _SALES])
+        assert out == [["a", 60.0, 2.0, 3], ["b", 12.0, 1.0, 2]]
+        names = tp.final_schema().names()
+        assert names == ["store", "sum(amount)", "mean(qty)", "count(t)"]
+
+    def test_reduce_stdev_range_first_last(self):
+        from deeplearning4j_tpu.data import Reducer
+
+        red = (Reducer.Builder("store")
+               .stdev_columns("amount")
+               .range_columns("qty")
+               .take_first_columns("t")
+               .build())
+        out = (TransformProcess.Builder(_sales_schema())
+               .reduce(red).build()).execute([list(r) for r in _SALES])
+        np.testing.assert_allclose(out[0][1], np.std([10, 20, 30], ddof=1))
+        assert out[0][2] == 2.0 and out[0][3] == 0
+
+    def test_reduce_json_roundtrip(self):
+        from deeplearning4j_tpu.data import Reducer
+
+        tp = (TransformProcess.Builder(_sales_schema())
+              .reduce(Reducer.Builder("store").sum_columns("amount").build())
+              .build())
+        tp2 = TransformProcess.from_json(tp.to_json())
+        assert tp2.execute([list(r) for r in _SALES])[0][:2] == ["a", 60.0]
+
+
+class TestDualColumnAndConditional:
+    def test_columns_math_op(self):
+        tp = (TransformProcess.Builder(_sales_schema())
+              .columns_math_op("total", "multiply", "amount", "qty")
+              .build())
+        out = tp.execute([list(r) for r in _SALES])
+        assert out[0][-1] == 10.0 and out[2][-1] == 90.0
+        assert tp.final_schema().names()[-1] == "total"
+
+    def test_conditional_copy(self):
+        tp = (TransformProcess.Builder(_sales_schema())
+              .conditional_copy("amount", "qty", "store", "eq", "b")
+              .build())
+        out = tp.execute([list(r) for r in _SALES])
+        assert out[3][1] == 1.0 and out[0][1] == 10.0
+
+
+class TestSequenceOps:
+    def test_convert_split_offset_window(self):
+        from deeplearning4j_tpu.data import (
+            Reducer,
+            SplitMaxLengthSequence,
+            convert_to_sequence,
+            offset_sequence,
+            reduce_sequence_by_window,
+            split_sequences,
+        )
+
+        schema = _sales_schema()
+        seqs = convert_to_sequence(schema, [list(r) for r in _SALES],
+                                   "store", sort_column="t")
+        assert [len(s) for s in seqs] == [3, 2]
+        assert seqs[0][0][3] == 0 and seqs[0][2][3] == 2
+
+        chunks = split_sequences(seqs, SplitMaxLengthSequence(2))
+        assert [len(s) for s in chunks] == [2, 1, 2]
+
+        # lag feature: amount shifted by +1 step, first step trimmed
+        lagged = offset_sequence(schema, seqs, ["amount"], 1)
+        assert len(lagged[0]) == 2
+        assert lagged[0][0][1] == 10.0 and lagged[0][0][3] == 1  # t=1 row, t=0 amount
+
+        red = Reducer.Builder("store").mean_columns("amount").build()
+        win = reduce_sequence_by_window(schema, seqs, 2, red)
+        assert win[0] == [["a", 15.0], ["a", 30.0]]
+
+
+class TestQualityAnalysis:
+    def test_quality_counts(self):
+        from deeplearning4j_tpu.data import DataQualityAnalysis
+
+        schema = (Schema.Builder()
+                  .add_column_double("x")
+                  .add_column_categorical("c", "u", "v")
+                  .build())
+        rows = [[1.0, "u"], ["oops", "v"], [None, "w"], [float("inf"), "u"],
+                [2.5, ""]]
+        q = DataQualityAnalysis.analyze(schema, rows)
+        x = q.column_quality["x"]
+        assert (x.valid, x.invalid, x.missing, x.total) == (2, 2, 1, 5)
+        c = q.column_quality["c"]
+        assert (c.valid, c.invalid, c.missing, c.total) == (3, 1, 1, 5)
+        assert "\"valid\": 2" in q.to_json()
+
+
+class TestWave3ReviewFixes:
+    def test_reduce_schema_matches_rows_when_key_not_first(self):
+        from deeplearning4j_tpu.data import Reducer
+
+        schema = (Schema.Builder().add_column_double("amount")
+                  .add_column_string("user").build())
+        tp = (TransformProcess.Builder(schema)
+              .reduce(Reducer.Builder("user").sum_columns("amount").build())
+              .build())
+        out = tp.execute([[1.0, "u1"], [2.0, "u1"], [5.0, "u2"]])
+        fs = tp.final_schema()
+        assert fs.names() == ["user", "sum(amount)"]
+        # schema index_of must agree with the data positions
+        assert out[0][fs.index_of("user")] == "u1"
+        assert out[0][fs.index_of("sum(amount)")] == 3.0
+
+    def test_columns_math_divide_by_zero_is_inf(self):
+        schema = (Schema.Builder().add_column_double("a")
+                  .add_column_double("b").build())
+        tp = (TransformProcess.Builder(schema)
+              .columns_math_op("r", "divide", "a", "b").build())
+        out = tp.execute([[1.0, 0.0], [4.0, 2.0]])
+        assert out[0][-1] == float("inf") and out[1][-1] == 2.0
+
+    def test_offset_sequence_new_column_and_bad_mode(self):
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.data import convert_to_sequence, offset_sequence
+
+        schema = _sales_schema()
+        seqs = convert_to_sequence(schema, [list(r) for r in _SALES], "store", "t")
+        nc = offset_sequence(schema, seqs, ["amount"], 1, mode="new_column")
+        assert len(nc[0][0]) == 5                       # original row + lag col
+        assert nc[0][0][1] == 20.0 and nc[0][0][4] == 10.0
+        with _pytest.raises(ValueError, match="mode"):
+            offset_sequence(schema, seqs, ["amount"], 1, mode="bogus")
