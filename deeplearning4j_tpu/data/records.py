@@ -227,3 +227,84 @@ class LabeledFileRecordReader(RecordReader):
 
     def read_index(self, idx: int) -> List:
         raise NotImplementedError
+
+
+class SVMLightRecordReader(LineRecordReader):
+    """datavec ``impl.misc.SVMLightRecordReader``: parse libsvm/SVMLight
+    lines ``label idx:value idx:value ... [# comment]`` into dense rows
+    ``[f0 .. f_{n-1}, label]`` (label last — the reference's writable
+    layout). Indices are 1-based per the libsvm format; ``num_features``
+    fixes the dense width; labels pass through unchanged (interpretation
+    is the iterator's job, as in the reference)."""
+
+    def __init__(self, num_features: int):
+        super().__init__()
+        self.num_features = int(num_features)
+
+    def next(self) -> List[float]:
+        line = super().next()[0].strip()
+        if "#" in line:
+            line = line.split("#", 1)[0].strip()
+        parts = line.split()
+        row = [0.0] * self.num_features
+        label = float(parts[0]) if parts else 0.0
+        for tok in parts[1:]:
+            idx, _, val = tok.partition(":")
+            i = int(idx) - 1  # libsvm indices are 1-based
+            if not 0 <= i < self.num_features:
+                # the reference throws on out-of-range indices — dropping
+                # them would silently train on corrupt all-zero rows
+                raise ValueError(
+                    f"SVMLight feature index {idx} outside "
+                    f"[1, {self.num_features}] in line {line!r} "
+                    "(wrong num_features, or 0-based data?)")
+            row[i] = float(val)
+        return row + [label]
+
+
+class RegexLineRecordReader(LineRecordReader):
+    """datavec ``impl.regex.RegexLineRecordReader``: each line matched
+    against a regex; the capture groups become the record's columns.
+    ``skip_num_lines`` skips headers; a non-matching line raises (the
+    reference throws IllegalStateException)."""
+
+    def __init__(self, regex: str, skip_num_lines: int = 0):
+        super().__init__()
+        import re
+
+        self.pattern = re.compile(regex)
+        self.skip_num_lines = skip_num_lines
+
+    def initialize(self, split: InputSplit) -> "RegexLineRecordReader":
+        # skip per FILE (the reference's behavior, and CSVRecordReader's in
+        # this module): every file's header lines go, not just the first's
+        self._lines = []
+        for path in split.locations():
+            with open(path, encoding="utf-8") as f:
+                lines = [line.rstrip("\n") for line in f]
+            self._lines.extend(lines[self.skip_num_lines:])
+        self._pos = 0
+        return self
+
+    def next(self) -> List[str]:
+        line = super().next()[0]
+        m = self.pattern.fullmatch(line)  # whole line, Matcher.matches parity
+        if m is None:
+            raise ValueError(f"line does not match regex: {line!r}")
+        return list(m.groups())
+
+
+class JacksonLineRecordReader(LineRecordReader):
+    """datavec ``impl.jackson.JacksonLineRecordReader``: one JSON object
+    per line; ``field_selection`` names the fields (in order) that become
+    the record's columns, with None for absent fields."""
+
+    def __init__(self, field_selection: List[str]):
+        super().__init__()
+        self.field_selection = list(field_selection)
+
+    def next(self) -> List:
+        import json as _json
+
+        obj = _json.loads(super().next()[0])
+        return [obj.get(f) for f in self.field_selection]

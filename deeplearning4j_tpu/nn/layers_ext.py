@@ -503,3 +503,58 @@ import numpy as np  # noqa: E402  (shape math in _type_for_keras_shape)
 
 for _cls in (ReshapeLayer, PermuteLayer, RepeatVectorLayer, GRULayer):
     _REG[_cls.__name__] = _cls
+
+
+@dataclass
+class OCNNOutputLayer(Layer):
+    """One-class neural network output (ref: conf.ocnn.OCNNOutputLayer,
+    Chalapathy et al. 2018): anomaly score w·g(Vx) with objective
+    0.5||V||² + 0.5||w||² + (1/ν)·mean(relu(r − score)) − r.
+
+    Design departure from the reference, by construction: the reference
+    refreshes the margin r from a score quantile every
+    ``window_size`` iterations (a host-side sort). Here r is an ordinary
+    parameter optimized by the same compiled step — the objective is convex
+    in r with the ν-quantile as its minimizer, so gradient descent reaches
+    the same fixed point with zero host round trips (the TPU-native shape).
+
+    Labels are ignored (one-class training); ``forward`` returns the score
+    minus r, so positive outputs = inliers under the learned margin.
+    """
+
+    hidden_size: int = 10
+    nu: float = 0.04
+    activation: str = "sigmoid"  # g in the paper
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(1)
+
+    def init_params(self, key, it: InputType, dtype=jnp.float32):
+        n_in = it.flat_size()
+        k1, k2 = jax.random.split(key)
+        return {
+            "V": init_weights(k1, (n_in, self.hidden_size), n_in,
+                              self.hidden_size, self.weight_init, dtype),
+            "w": init_weights(k2, (self.hidden_size, 1), self.hidden_size, 1,
+                              self.weight_init, dtype),
+            "r": jnp.zeros((), dtype),
+        }
+
+    def _score(self, params, x):
+        g = act.get(self.activation)
+        return (g(x @ params["V"]) @ params["w"])[:, 0]
+
+    def forward(self, params, x, it, *, training, rng=None):
+        return (self._score(params, x) - params["r"])[:, None]
+
+    def compute_loss(self, params, x, labels, it, *, training, rng=None, mask=None):
+        x = self._apply_dropout(x, training, rng)
+        s = self._score(params, x).astype(jnp.float32)
+        r = params["r"].astype(jnp.float32)
+        reg = 0.5 * (jnp.sum(jnp.square(params["V"]))
+                     + jnp.sum(jnp.square(params["w"])))
+        hinge = jnp.mean(jax.nn.relu(r - s)) / self.nu
+        return reg + hinge - r
+
+
+_REG[OCNNOutputLayer.__name__] = OCNNOutputLayer

@@ -211,18 +211,15 @@ class MultiLayerNetwork(_LazyScoreMixin):
 
     # ------------------------------------------------------------- train step
 
-    def _train_step_fn(self):
-        """Build/jit-cache THE train step: grads+updater+apply in one XLA
-        program with donated state (§3.2 'TPU equivalent' note)."""
+    def _step_body(self):
+        """The raw (unjitted) train step — jitted by ``_train_step_fn`` and
+        scanned by ``_train_scan_fn``."""
         # AMP (TDL_MATMUL_PRECISION=bfloat16): forward/backward in bf16 off a
         # cast-on-entry copy; masters/grads/updater stay fp32 (the entry cast's
         # transpose re-accumulates grads in fp32). Cache keyed on the resolved
         # policy so env().set("matmul_precision", ...) mid-run takes effect.
         amp = amp_enabled(self._dtype)
         cdt = compute_dtype()
-        cache_key = ("train", amp)
-        if cache_key in self._jit_cache:
-            return self._jit_cache[cache_key]
         updater = self.conf.updater
         gn, gnt = self.conf.gradient_normalization, self.conf.gradient_normalization_threshold
 
@@ -242,6 +239,16 @@ class MultiLayerNetwork(_LazyScoreMixin):
             new_params = self._apply_constraints(new_params)
             return new_params, new_upd, new_bn, loss
 
+        return step, amp
+
+    def _train_step_fn(self):
+        """Build/jit-cache THE train step: grads+updater+apply in one XLA
+        program with donated state (§3.2 'TPU equivalent' note)."""
+        amp = amp_enabled(self._dtype)
+        cache_key = ("train", amp)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
+        step, _ = self._step_body()
         jitted = jax.jit(step, donate_argnums=(0, 1, 2))
         from ..common.debug import buffers_debug_enabled, donation_guard
 
@@ -342,6 +349,74 @@ class MultiLayerNetwork(_LazyScoreMixin):
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self)
         return self
+
+    def _train_scan_fn(self, has_fmask: bool, has_lmask: bool):
+        """K whole train steps in ONE executable (generalization of the
+        tbptt segment fusion to any model — see ComputationGraph.fit_scan)."""
+        amp = amp_enabled(self._dtype)
+        cache_key = ("train_scan", amp, has_fmask, has_lmask)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
+        step, _ = self._step_body()
+
+        def scan_fit(params, upd_state, bn_state, iteration, epoch, xs, ys,
+                     fms, lms, rng):
+            def body(carry, seg):
+                params, upd, bn, it = carry
+                x, y = seg[0], seg[1]
+                k = 2
+                fm = seg[k] if has_fmask else None
+                k += 1 if has_fmask else 0
+                lm = seg[k] if has_lmask else None
+                params, upd, bn, loss = step(
+                    params, upd, bn, it, epoch, x, y, fm, lm,
+                    jax.random.fold_in(rng, it))
+                return (params, upd, bn, it + 1), loss
+
+            segs = tuple(s for s, keep in
+                         ((xs, True), (ys, True), (fms, has_fmask), (lms, has_lmask))
+                         if keep)
+            (params, upd_state, bn_state, _), losses = jax.lax.scan(
+                body, (params, upd_state, bn_state, iteration), segs)
+            return params, upd_state, bn_state, losses
+
+        self._jit_cache[cache_key] = jax.jit(scan_fit, donate_argnums=(0, 1, 2))
+        return self._jit_cache[cache_key]
+
+    def fit_scan(self, datasets) -> np.ndarray:
+        """Fit a list of equal-shaped DataSets as ONE compiled dispatch;
+        returns per-step losses. Not available on the tbptt path (that
+        already scan-fuses within each batch)."""
+        if self.conf.backprop_type == "TruncatedBPTT" and self.conf.tbptt_fwd_length > 0:
+            raise ValueError("fit_scan: use fit() — tbptt already scan-fuses")
+        datasets = list(datasets)
+        if not datasets:
+            return np.zeros(0, np.float32)
+        has_fm = datasets[0].features_mask is not None
+        has_lm = datasets[0].labels_mask is not None
+        for ds in datasets[1:]:
+            if (ds.features_mask is not None) != has_fm or \
+                    (ds.labels_mask is not None) != has_lm:
+                raise ValueError("fit_scan: all datasets must agree on "
+                                 "features/labels masks")
+        xs = jnp.stack([self._put(ds.features, self._dtype) for ds in datasets])
+        ys = jnp.stack([self._put(ds.labels) for ds in datasets])
+        fms = (jnp.stack([self._put(ds.features_mask) for ds in datasets])
+               if has_fm else None)
+        lms = (jnp.stack([self._put(ds.labels_mask) for ds in datasets])
+               if has_lm else None)
+        scan_fit = self._train_scan_fn(has_fm, has_lm)
+        rng = jax.random.key(self.conf.seed ^ 0x5EED)
+        self.params_, self.updater_state, self.bn_state, losses = scan_fit(
+            self.params_, self.updater_state, self.bn_state,
+            jnp.asarray(self.iteration, jnp.int32),
+            jnp.asarray(self.epoch, jnp.int32), xs, ys, fms, lms, rng)
+        self.iteration += len(datasets)
+        self.score_ = losses[-1]  # lazy
+        for lst in self.listeners:
+            if hasattr(lst, "iteration_done"):
+                lst.iteration_done(self, self.iteration, self.epoch)
+        return losses
 
     def _fit_batch(self, ds: DataSet):
         if self.conf.backprop_type == "TruncatedBPTT" and self.conf.tbptt_fwd_length > 0:

@@ -1,3 +1,3 @@
-from .model_serializer import ModelSerializer
+from .model_serializer import ModelGuesser, ModelSerializer
 
-__all__ = ["ModelSerializer"]
+__all__ = ["ModelGuesser", "ModelSerializer"]

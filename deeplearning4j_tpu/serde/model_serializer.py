@@ -143,3 +143,41 @@ class ModelSerializer:
     def restore(path: str, load_updater: bool = True):
         """ModelGuesser equivalent: restore whichever model kind the zip holds."""
         return ModelSerializer._restore(path, load_updater)
+
+
+class ModelGuesser:
+    """``org.deeplearning4j.util.ModelGuesser`` parity: load a model file of
+    unknown provenance — a ModelSerializer zip (MultiLayerNetwork or
+    ComputationGraph), a Keras HDF5 (Sequential or Functional), or a frozen
+    TF GraphDef .pb — by sniffing the container format, not the extension."""
+
+    @staticmethod
+    def load_model_guess(path: str):
+        import zipfile
+
+        if zipfile.is_zipfile(path):
+            with zipfile.ZipFile(path) as z:
+                ours = "configuration.json" in z.namelist()
+            if not ours:  # e.g. a Keras v3 .keras zip — not our container
+                raise ValueError(
+                    f"cannot guess model format of {path}: a zip without "
+                    "ModelSerializer's configuration.json (.keras v3 zips "
+                    "are unsupported — re-save as legacy HDF5)")
+            return ModelSerializer.restore(path)
+        with open(path, "rb") as f:
+            magic = f.read(8)
+        if magic.startswith(b"\x89HDF") or magic.startswith(b"\x0e\x03\x13\x01"):
+            from ..modelimport.keras_import import KerasModelImport
+
+            return KerasModelImport.import_model(path)
+        # GraphDef protos start with a node field tag (0x0a); cheap check
+        # then a real parse attempt
+        if magic[:1] == b"\x0a":
+            from ..modelimport.tf_import import TFGraphMapper
+
+            return TFGraphMapper.import_frozen_graph(path)
+        raise ValueError(
+            f"cannot guess model format of {path}: not a ModelSerializer "
+            "zip, Keras HDF5, or frozen TF GraphDef")
+
+    loadModelGuess = load_model_guess

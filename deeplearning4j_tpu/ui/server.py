@@ -116,6 +116,33 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(getattr(self.server, "model_graph", None) or
                        {"error": "no model attached"})
             return
+        if self.path == "/arbiter/data":
+            self._json(getattr(self.server, "arbiter_result", None) or
+                       {"error": "no arbiter run attached"})
+            return
+        if self.path == "/arbiter":
+            res = getattr(self.server, "arbiter_result", None)
+            if not res:
+                self._html("<html><body><h2>Arbiter</h2><p>no run attached — "
+                           "UIServer.attach_arbiter(result)</p></body></html>")
+                return
+            import html as _h
+
+            fmt = lambda s: "failed" if s is None else f"{s:.6g}"  # noqa: E731
+            rows = "".join(
+                f"<tr{' style=background:#e6ffe6' if i == res['best_index'] else ''}>"
+                f"<td>{i}</td><td>{_h.escape(json.dumps(t['candidate']))}</td>"
+                f"<td style='text-align:right'>{fmt(t['score'])}</td></tr>"
+                for i, t in enumerate(res["trials"]))
+            self._html(
+                "<html><head><style>body{font-family:sans-serif;margin:20px}"
+                "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+                "padding:4px 10px}</style></head><body>"
+                f"<h2>Arbiter — {len(res['trials'])} trials, best score "
+                f"{fmt(res['best_score'])} (trial {res['best_index']})</h2>"
+                f"<table><tr><th>#</th><th>candidate</th><th>score</th></tr>"
+                f"{rows}</table></body></html>")
+            return
         self._json({"error": "not found"}, 404)
 
     def do_POST(self):
@@ -233,6 +260,28 @@ class UIServer:
         self._httpd.model_graph = model_graph_json(net)
 
     attachModel = attach_model
+
+    def attach_arbiter(self, result) -> None:
+        """Arbiter tab (ref: arbiter-ui ArbiterModule): /arbiter renders a
+        trial table from an ``OptimizationResult``; /arbiter/data serves it
+        as JSON."""
+        if self._httpd is None:
+            self._start(self._storages[0] if self._storages else StatsStorage())
+        import math
+
+        def _score(s):  # failed trials record inf — not valid strict JSON
+            return None if not math.isfinite(s) else s
+
+        self._httpd.arbiter_result = {
+            "best_candidate": {k: v for k, v in result.best_candidate.items()},
+            "best_score": _score(result.best_score),
+            "best_index": result.best_index,
+            "trials": [{"candidate": {k: v for k, v in c.items()
+                                      if k != "__id__"}, "score": _score(s)}
+                       for c, s in result.all_results],
+        }
+
+    attachArbiter = attach_arbiter
 
     def _start(self, storage: StatsStorage):
         handler = type("BoundHandler", (_Handler,), {"storage": storage})

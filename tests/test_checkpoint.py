@@ -144,3 +144,33 @@ class TestPreemption:
         assert ck.restore(net2, tag="preempt")
         np.testing.assert_array_equal(
             np.asarray(net2.params_["0"]["W"]), np.asarray(net.params_["0"]["W"]))
+
+
+def test_model_guesser(tmp_path):
+    """ModelGuesser: format sniffing across the three container types."""
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.serde import ModelGuesser, ModelSerializer
+
+    net = LeNet(num_classes=3, input_shape=(1, 8, 8)).init()
+    p = str(tmp_path / "m.zip")
+    ModelSerializer.write_model(net, p)
+    loaded = ModelGuesser.load_model_guess(p)
+    x = np.random.RandomState(0).rand(2, 1, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(loaded.output(x).numpy(), net.output(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+    keras = _pytest.importorskip("keras")
+    m = keras.Sequential([keras.Input((6,)), keras.layers.Dense(4)])
+    kp = str(tmp_path / "k.h5")
+    m.save(kp)
+    knet = ModelGuesser.load_model_guess(kp)
+    xk = np.random.RandomState(1).randn(3, 6).astype(np.float32)
+    np.testing.assert_allclose(knet.output(xk).numpy(), m.predict(xk, verbose=0),
+                               rtol=1e-4, atol=1e-5)
+
+    bad = str(tmp_path / "junk.bin")
+    open(bad, "wb").write(b"\x00\x01\x02garbage")
+    with _pytest.raises(ValueError, match="cannot guess"):
+        ModelGuesser.load_model_guess(bad)

@@ -251,3 +251,44 @@ def test_transform_wave2_time_condition_join_analysis():
     assert an.column_stats["amount"]["max"] == 50.0
     assert an.column_stats["user"]["countUnique"] == 2
     assert "mean" in an.column_stats["ts_hourOfDay"]
+
+
+class TestD6ReaderTail:
+    """D6 breadth (SVMLight / regex / JSON-lines readers)."""
+
+    def test_svmlight(self, tmp_path):
+        from deeplearning4j_tpu.data import SVMLightRecordReader
+        from deeplearning4j_tpu.data.records import FileSplit
+
+        p = tmp_path / "d.svm"
+        p.write_text("1 1:0.5 3:2.0 # note\n0 2:-1.5\n")
+        rr = SVMLightRecordReader(num_features=4).initialize(FileSplit(str(tmp_path)))
+        assert rr.next() == [0.5, 0.0, 2.0, 0.0, 1.0]
+        assert rr.next() == [0.0, -1.5, 0.0, 0.0, 0.0]
+        assert not rr.has_next()
+
+    def test_regex_reader(self, tmp_path):
+        from deeplearning4j_tpu.data import RegexLineRecordReader
+        from deeplearning4j_tpu.data.records import FileSplit
+
+        p = tmp_path / "log.txt"
+        p.write_text("header\n2026-01-01 WARN disk full\n2026-01-02 INFO ok\n")
+        rr = RegexLineRecordReader(r"(\d{4}-\d{2}-\d{2}) (\w+) (.*)",
+                                   skip_num_lines=1).initialize(FileSplit(str(tmp_path)))
+        assert rr.next() == ["2026-01-01", "WARN", "disk full"]
+        assert rr.next() == ["2026-01-02", "INFO", "ok"]
+        import pytest as _pytest
+
+        rr2 = RegexLineRecordReader(r"(\d+)").initialize(FileSplit(str(tmp_path)))
+        with _pytest.raises(ValueError, match="does not match"):
+            rr2.next()
+
+    def test_jackson_lines(self, tmp_path):
+        from deeplearning4j_tpu.data import JacksonLineRecordReader
+        from deeplearning4j_tpu.data.records import FileSplit
+
+        p = tmp_path / "rows.jsonl"
+        p.write_text('{"a": 1, "b": "x"}\n{"b": "y", "c": 3}\n')
+        rr = JacksonLineRecordReader(["a", "b"]).initialize(FileSplit(str(tmp_path)))
+        assert rr.next() == [1, "x"]
+        assert rr.next() == [None, "y"]

@@ -56,3 +56,32 @@ def test_fit_scan_matches_sequential():
         np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
         seq.bn_state, fused.bn_state)
     assert fused.iteration == 4
+
+
+def test_mln_fit_scan_matches_sequential():
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    def build():
+        conf = (NeuralNetConfiguration.Builder().seed(9).updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(5)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(1)
+    batches = [DataSet(rs.rand(6, 5).astype(np.float32),
+                       np.eye(2, dtype=np.float32)[rs.randint(0, 2, 6)])
+               for _ in range(5)]
+    seq = build()
+    for ds in batches:
+        seq._fit_batch(ds)
+    fused = build()
+    losses = fused.fit_scan(batches)
+    assert losses.shape == (5,)
+    np.testing.assert_allclose(np.asarray(seq.params().numpy()),
+                               np.asarray(fused.params().numpy()),
+                               rtol=2e-5, atol=2e-6)
+    assert fused.iteration == 5

@@ -199,3 +199,26 @@ def test_remote_router_drops_when_unreachable():
                                         retry_backoff_ms=1)
     router.put_record({"score": 1.0})  # must not raise / stall
     assert router.dropped == 1
+
+
+def test_arbiter_tab():
+    """A2 tail: the arbiter UI tab renders an OptimizationResult."""
+    from deeplearning4j_tpu.arbiter.optimize import OptimizationResult
+
+    res = OptimizationResult(
+        best_candidate={"lr": 0.01}, best_score=0.12, best_index=1,
+        all_results=[({"lr": 0.1, "__id__": 0}, 0.5),
+                     ({"lr": 0.01, "__id__": 1}, 0.12)])
+    server = UIServer(port=0)
+    server.attach_arbiter(res)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/arbiter/data", timeout=10) as r:
+            d = json.loads(r.read())
+        assert d["best_score"] == 0.12 and len(d["trials"]) == 2
+        assert "__id__" not in d["trials"][0]["candidate"]
+        with urllib.request.urlopen(base + "/arbiter", timeout=10) as r:
+            page = r.read().decode()
+        assert "2 trials" in page and "0.12" in page
+    finally:
+        server.stop()

@@ -56,3 +56,26 @@ def test_init_pretrained_checksum(tmp_path):
             p, checksum="0" * 64)
     with _pytest.raises(ValueError, match="zero egress|downloaded"):
         LeNet().init_pretrained()
+
+
+def test_ocnn_output_layer_learns_inlier_region():
+    """OCNN (C4 tail): train on one cluster; inliers must score higher than
+    far-away outliers."""
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType
+    from deeplearning4j_tpu.nn.layers_ext import OCNNOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rs = np.random.RandomState(0)
+    X = (rs.randn(256, 4) * 0.3 + 2.0).astype(np.float32)   # tight cluster at 2
+    conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OCNNOutputLayer(hidden_size=8, nu=0.1))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    dummy_y = np.zeros((256, 1), np.float32)
+    for _ in range(120):
+        net.fit(DataSet(X, dummy_y))
+    inl = net.output(X[:32]).numpy().mean()
+    outl = net.output((rs.randn(32, 4) * 0.3 - 6.0).astype(np.float32)).numpy().mean()
+    assert inl > outl + 0.05, (inl, outl)
