@@ -469,3 +469,108 @@ class CachedImageDataSetIterator(DataSetIterator):
         self.reset()
         while self.has_next():
             yield self.next()
+
+
+class VideoRecordReader(LabeledFileRecordReader):
+    """datavec ``codec.reader.CodecRecordReader`` parity, scoped to the
+    containers PIL decodes without native codec libraries: multi-frame
+    image files (animated GIF/TIFF/WebP) and directories-of-frames. Each
+    record is a sequence ``[CHW float32] * num_frames`` (+ label when a
+    generator is set) — the reference's record-per-video layout.
+
+    ffmpeg-backed containers (mp4/avi) need JavaCV/ffmpeg, which this
+    zero-egress image does not ship — documented exclusion in README; the
+    frames-directory mode is the standard workaround (``ffmpeg -i v.mp4
+    frames/%d.png`` offline, then read the directory).
+    """
+
+    _extensions = (".gif", ".tiff", ".tif", ".webp")
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 start_frame: int = 0, num_frames: int = 0,
+                 rows_per_sequence: int = 0,
+                 label_generator: Optional[PathLabelGenerator] = None):
+        super().__init__(label_generator)
+        self.height, self.width, self.channels = height, width, channels
+        self.start_frame = start_frame
+        self.num_frames = num_frames  # 0 = all
+        del rows_per_sequence  # reference knob, subsumed by num_frames
+
+    def read_index(self, idx: int) -> List:
+        from PIL import Image, ImageSequence
+
+        path = self._files[idx]
+        frames = []
+        with Image.open(path) as im:
+            it = ImageSequence.Iterator(im)
+            for fi, frame in enumerate(it):
+                if fi < self.start_frame:
+                    continue
+                if self.num_frames and len(frames) >= self.num_frames:
+                    break
+                f = frame.convert("RGB" if self.channels == 3 else "L")
+                if f.size != (self.width, self.height):
+                    f = f.resize((self.width, self.height), Image.BILINEAR)
+                arr = np.asarray(f, np.float32)
+                if arr.ndim == 2:
+                    arr = arr[:, :, None]
+                frames.append(arr.transpose(2, 0, 1))
+        out: List = [np.stack(frames)] if frames else [np.zeros(
+            (0, self.channels, self.height, self.width), np.float32)]
+        if self.label_gen is not None:
+            out.append(self._label_of(path))
+        return out
+
+
+class FrameDirectoryRecordReader:
+    """Directory-of-frames video reader: each SUBDIRECTORY is one video,
+    its (sorted) image files the frames — the offline-ffmpeg workflow's
+    reader half. Record layout matches VideoRecordReader: ``[frames
+    [T,C,H,W], label_index]`` with the vocabulary from ``labels()``
+    (video-directory names, sorted)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.height, self.width, self.channels = height, width, channels
+        self._videos: List[Tuple[str, List[str]]] = []
+        self._labels: List[str] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit) -> "FrameDirectoryRecordReader":
+        byd: dict = {}
+        for p in sorted(split.locations()):
+            if p.lower().endswith(_IMG_EXTS):
+                byd.setdefault(os.path.dirname(p), []).append(p)
+        self._videos = sorted(byd.items())
+        self._labels = sorted(os.path.basename(d) for d, _ in self._videos)
+        self._pos = 0
+        return self
+
+    def labels(self) -> List[str]:
+        return list(self._labels)
+
+    def num_labels(self) -> int:
+        return len(self._labels)
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._videos)
+
+    def reset(self):
+        self._pos = 0
+
+    def next(self) -> List:
+        from PIL import Image
+
+        dirname, files = self._videos[self._pos]
+        self._pos += 1
+        frames = []
+        for p in sorted(files):
+            with Image.open(p) as im:
+                f = im.convert("RGB" if self.channels == 3 else "L")
+                if f.size != (self.width, self.height):
+                    f = f.resize((self.width, self.height), Image.BILINEAR)
+                arr = np.asarray(f, np.float32)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            frames.append(arr.transpose(2, 0, 1))
+        return [np.stack(frames),
+                self._labels.index(os.path.basename(dirname))]

@@ -250,6 +250,8 @@ class SVMLightRecordReader(LineRecordReader):
         label = float(parts[0]) if parts else 0.0
         for tok in parts[1:]:
             idx, _, val = tok.partition(":")
+            if idx == "qid":  # ranking extension ('label qid:N f:v ...')
+                continue
             i = int(idx) - 1  # libsvm indices are 1-based
             if not 0 <= i < self.num_features:
                 # the reference throws on out-of-range indices — dropping

@@ -20,6 +20,7 @@ from .transformer import (
 from .zoo import LeNet, SimpleCNN, ZooModel
 from .resnet import ResNet50
 from .facenet import InceptionResNetV1
+from .nasnet import NASNet
 from .vgg import VGG16, VGG19
 from .text_lstm import TextGenerationLSTM
 from .zoo_ext import AlexNet, Darknet19, SqueezeNet, UNet, Xception
@@ -43,5 +44,6 @@ __all__ = [
     "VGG16",
     "VGG19",
     "InceptionResNetV1",
+    "NASNet",
     "TextGenerationLSTM",
 ]

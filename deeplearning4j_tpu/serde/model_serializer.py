@@ -171,11 +171,17 @@ class ModelGuesser:
 
             return KerasModelImport.import_model(path)
         # GraphDef protos start with a node field tag (0x0a); cheap check
-        # then a real parse attempt
+        # then a real parse attempt — a failed parse (any newline-leading
+        # file matches the cheap check) falls through to 'cannot guess'
         if magic[:1] == b"\x0a":
-            from ..modelimport.tf_import import TFGraphMapper
+            from ..modelimport.tf_import import TFGraphMapper, TFImportError
 
-            return TFGraphMapper.import_frozen_graph(path)
+            try:
+                return TFGraphMapper.import_frozen_graph(path)
+            except TFImportError:
+                raise  # real GraphDef with unsupported ops: surface that
+            except Exception:
+                pass
         raise ValueError(
             f"cannot guess model format of {path}: not a ModelSerializer "
             "zip, Keras HDF5, or frozen TF GraphDef")

@@ -292,3 +292,21 @@ class TestD6ReaderTail:
         rr = JacksonLineRecordReader(["a", "b"]).initialize(FileSplit(str(tmp_path)))
         assert rr.next() == [1, "x"]
         assert rr.next() == [None, "y"]
+
+
+def test_svmlight_qid_and_bad_index(tmp_path):
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.data import SVMLightRecordReader
+    from deeplearning4j_tpu.data.records import FileSplit
+
+    (tmp_path / "r.svm").write_text("2 qid:7 1:0.5\n")
+    rr = SVMLightRecordReader(num_features=2).initialize(FileSplit(str(tmp_path)))
+    assert rr.next() == [0.5, 0.0, 2.0]
+
+    (tmp_path / "bad").mkdir()
+    (tmp_path / "bad" / "b.svm").write_text("1 9:1.0\n")
+    rr2 = SVMLightRecordReader(num_features=2).initialize(
+        FileSplit(str(tmp_path / "bad")))
+    with _pytest.raises(ValueError, match="outside"):
+        rr2.next()

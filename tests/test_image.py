@@ -137,3 +137,46 @@ class TestImageRecordReader:
         ev = net.evaluate(ImageRecordReaderDataSetIterator(
             rr, 12, preprocessor=ImagePreProcessingScaler()))
         assert ev.accuracy() > 0.9, ev.accuracy()
+
+
+class TestVideoReaders:
+    def test_gif_video_reader(self, tmp_path):
+        from PIL import Image
+
+        from deeplearning4j_tpu.data import VideoRecordReader
+        from deeplearning4j_tpu.data.image import ParentPathLabelGenerator
+        from deeplearning4j_tpu.data.records import FileSplit
+
+        d = tmp_path / "walk"
+        d.mkdir()
+        rs = np.random.RandomState(0)
+        frames = [Image.fromarray(rs.randint(0, 255, (12, 10, 3), dtype=np.uint8))
+                  for _ in range(5)]
+        frames[0].save(str(d / "v.gif"), save_all=True,
+                       append_images=frames[1:])
+        rr = VideoRecordReader(8, 8, 3, start_frame=1, num_frames=3,
+                               label_generator=ParentPathLabelGenerator())
+        rr.initialize(FileSplit(str(tmp_path)))
+        rec = rr.next()
+        assert rec[0].shape == (3, 3, 8, 8)   # [T,C,H,W]
+        assert rec[1] == 0 and rr.labels() == ["walk"]
+
+    def test_frame_directory_reader(self, tmp_path):
+        from PIL import Image
+
+        from deeplearning4j_tpu.data import FrameDirectoryRecordReader
+        from deeplearning4j_tpu.data.records import FileSplit
+
+        rs = np.random.RandomState(1)
+        for vid in ("a", "b"):
+            d = tmp_path / vid
+            d.mkdir()
+            for t in range(4):
+                Image.fromarray(rs.randint(0, 255, (6, 6, 3), dtype=np.uint8)).save(
+                    str(d / f"{t:03d}.png"))
+        rr = FrameDirectoryRecordReader(6, 6, 3).initialize(FileSplit(str(tmp_path)))
+        assert rr.labels() == ["a", "b"]
+        seq, lab = rr.next()
+        assert seq.shape == (4, 3, 6, 6) and lab == 0
+        seq2, lab2 = rr.next()
+        assert lab2 == 1 and not rr.has_next()

@@ -79,3 +79,18 @@ def test_ocnn_output_layer_learns_inlier_region():
     inl = net.output(X[:32]).numpy().mean()
     outl = net.output((rs.randn(32, 4) * 0.3 - 6.0).astype(np.float32)).numpy().mean()
     assert inl > outl + 0.05, (inl, outl)
+
+
+def test_nasnet_builds_and_steps():
+    from deeplearning4j_tpu.models import NASNet
+
+    m = NASNet(num_classes=6, input_shape=(3, 64, 64),
+               penultimate_filters=96, num_cells=1, stem_filters=8)
+    net = m.init()
+    x = np.random.RandomState(0).rand(2, 3, 64, 64).astype(np.float32)
+    y = np.eye(6, dtype=np.float32)[[0, 5]]
+    net.fit({"input": x}, {"output": y})
+    assert np.isfinite(float(net.score_))
+    out = net.output_single(x).numpy()
+    assert out.shape == (2, 6)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
