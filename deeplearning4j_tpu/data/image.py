@@ -508,13 +508,8 @@ class VideoRecordReader(LabeledFileRecordReader):
                     continue
                 if self.num_frames and len(frames) >= self.num_frames:
                     break
-                f = frame.convert("RGB" if self.channels == 3 else "L")
-                if f.size != (self.width, self.height):
-                    f = f.resize((self.width, self.height), Image.BILINEAR)
-                arr = np.asarray(f, np.float32)
-                if arr.ndim == 2:
-                    arr = arr[:, :, None]
-                frames.append(arr.transpose(2, 0, 1))
+                frames.append(_frame_to_chw(frame, self.height, self.width,
+                                            self.channels))
         out: List = [np.stack(frames)] if frames else [np.zeros(
             (0, self.channels, self.height, self.width), np.float32)]
         if self.label_gen is not None:
@@ -522,15 +517,41 @@ class VideoRecordReader(LabeledFileRecordReader):
         return out
 
 
+def _frame_to_chw(pil_image, height: int, width: int, channels: int) -> np.ndarray:
+    """One decoded PIL image → CHW float32 (shared by the video readers)."""
+    from PIL import Image
+
+    f = pil_image.convert("RGB" if channels == 3 else "L")
+    if f.size != (width, height):
+        f = f.resize((width, height), Image.BILINEAR)
+    arr = np.asarray(f, np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr.transpose(2, 0, 1)
+
+
+def _natural_key(path: str):
+    """Numeric-aware sort key: ffmpeg's %d.png produces 1,2,...,10 which a
+    lexicographic sort would scramble into 1,10,11,...,2."""
+    import re
+
+    return [int(t) if t.isdigit() else t
+            for t in re.split(r"(\d+)", os.path.basename(path))]
+
+
 class FrameDirectoryRecordReader:
     """Directory-of-frames video reader: each SUBDIRECTORY is one video,
-    its (sorted) image files the frames — the offline-ffmpeg workflow's
-    reader half. Record layout matches VideoRecordReader: ``[frames
-    [T,C,H,W], label_index]`` with the vocabulary from ``labels()``
-    (video-directory names, sorted)."""
+    its frames sorted NUMERICALLY (ffmpeg ``%d.png`` output order) — the
+    offline-ffmpeg workflow's reader half. Record layout matches
+    VideoRecordReader: ``[frames [T,C,H,W], label_index]``; the label of a
+    video is produced by ``label_generator`` applied to the video DIRECTORY
+    (default ParentPathLabelGenerator: the class directory above the clip,
+    so same-named clips under different classes don't collide)."""
 
-    def __init__(self, height: int, width: int, channels: int = 3):
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 label_generator: Optional[PathLabelGenerator] = None):
         self.height, self.width, self.channels = height, width, channels
+        self.label_gen = label_generator or ParentPathLabelGenerator()
         self._videos: List[Tuple[str, List[str]]] = []
         self._labels: List[str] = []
         self._pos = 0
@@ -541,7 +562,10 @@ class FrameDirectoryRecordReader:
             if p.lower().endswith(_IMG_EXTS):
                 byd.setdefault(os.path.dirname(p), []).append(p)
         self._videos = sorted(byd.items())
-        self._labels = sorted(os.path.basename(d) for d, _ in self._videos)
+        # the generator is applied to the video DIRECTORY path, so the
+        # default ParentPathLabelGenerator yields the class dir above the clip
+        self._labels = sorted({self.label_gen.label_for_path(d)
+                               for d, _ in self._videos})
         self._pos = 0
         return self
 
@@ -563,14 +587,9 @@ class FrameDirectoryRecordReader:
         dirname, files = self._videos[self._pos]
         self._pos += 1
         frames = []
-        for p in sorted(files):
+        for p in sorted(files, key=_natural_key):
             with Image.open(p) as im:
-                f = im.convert("RGB" if self.channels == 3 else "L")
-                if f.size != (self.width, self.height):
-                    f = f.resize((self.width, self.height), Image.BILINEAR)
-                arr = np.asarray(f, np.float32)
-            if arr.ndim == 2:
-                arr = arr[:, :, None]
-            frames.append(arr.transpose(2, 0, 1))
-        return [np.stack(frames),
-                self._labels.index(os.path.basename(dirname))]
+                frames.append(_frame_to_chw(im, self.height, self.width,
+                                            self.channels))
+        label = self.label_gen.label_for_path(dirname)
+        return [np.stack(frames), self._labels.index(label)]

@@ -129,7 +129,7 @@ class NASNet(ZooModel):
         b5 = self._add(g, f"{name}_b5",
                        self._pool(g, f"{name}_b5a", b1, "avg"), b2)
         g.add_vertex(f"{name}_out", MergeVertex(), b2, b3, b4, b5)
-        return f"{name}_out", b5
+        return f"{name}_out"
 
     # -- full graph ---------------------------------------------------------
 
@@ -155,8 +155,8 @@ class NASNet(ZooModel):
             filters_stack = filters * (2 ** stack)
             if stack > 0:
                 # the reduction runs at the NEW stack's (doubled) width
-                cur2, _ = self._reduction_cell(g, f"red{stack}", cur, prev,
-                                               filters_stack)
+                cur2 = self._reduction_cell(g, f"red{stack}", cur, prev,
+                                            filters_stack)
                 prev, cur = cur, cur2
             for i in range(self.num_cells):
                 hp_stride = (2, 2) if (stack > 0 and i == 0) else (1, 1)

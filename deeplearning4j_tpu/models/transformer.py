@@ -268,16 +268,8 @@ def token_ce_loss(logits, labels, weights=None):
 def forward(params, tokens, cfg: TransformerConfig, *, segments=None, pad_mask=None,
             rng=None, train: bool = False):
     """tokens [B,T] int32 → logits [B,T,V] (float32)."""
-    h = embed(params, tokens, cfg, segments=segments)
-
-    block = functools.partial(_block, cfg)
-    if cfg.remat:
-        block = jax.checkpoint(block, static_argnums=())
-    for i, p in enumerate(params["blocks"]):
-        sub = jax.random.fold_in(rng, i) if rng is not None else None
-        h = block(p, h, pad_mask, sub, train)
-
-    return mlm_head(params, h, cfg)
+    return mlm_head(params, encode(params, tokens, cfg, segments=segments,
+                                   pad_mask=pad_mask, rng=rng, train=train), cfg)
 
 
 def loss_fn(params, batch, cfg: TransformerConfig, rng=None, train: bool = True):
@@ -296,5 +288,80 @@ def make_train_step(cfg: TransformerConfig, updater):
         updates, new_opt = updater.apply(grads, opt_state, params, iteration, 0)
         new_params = jax.tree.map(lambda p, u: (p - u).astype(p.dtype), params, updates)
         return new_params, new_opt, loss
+
+    return step
+
+
+# ----------------------------------------------------- SQuAD fine-tune head
+# (BASELINE configs[4]: "SameDiff BERT-base fine-tune (SQuAD)" — the
+# reference's headline SameDiff training workload, SURVEY §6. The span
+# head is the standard BertForQuestionAnswering shape: one dense [D,2]
+# over the encoder output producing start/end logits.)
+
+
+def encode(params, tokens, cfg: TransformerConfig, *, segments=None,
+           pad_mask=None, rng=None, train: bool = False):
+    """Encoder-only forward: tokens [B,T] → hidden states [B,T,D] (no head)."""
+    h = embed(params, tokens, cfg, segments=segments)
+    block = functools.partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block, static_argnums=())
+    for i, p in enumerate(params["blocks"]):
+        sub = jax.random.fold_in(rng, i) if rng is not None else None
+        h = block(p, h, pad_mask, sub, train)
+    return h
+
+
+def init_qa_head(key, cfg: TransformerConfig):
+    """Span head params: {'w': [D,2], 'b': [2]}."""
+    import numpy as _np
+
+    w = jax.random.normal(key, (cfg.d_model, 2), jnp.float32)
+    return {"w": w * _np.float32(0.02), "b": jnp.zeros((2,), jnp.float32)}
+
+
+def qa_forward(params, qa_params, tokens, cfg: TransformerConfig, *,
+               segments=None, pad_mask=None, rng=None, train: bool = False):
+    """→ (start_logits [B,T], end_logits [B,T]) fp32."""
+    h = encode(params, tokens, cfg, segments=segments, pad_mask=pad_mask,
+               rng=rng, train=train)
+    logits = h.astype(jnp.float32) @ qa_params["w"] + qa_params["b"]
+    return logits[..., 0], logits[..., 1]
+
+
+def qa_loss_fn(params, qa_params, batch, cfg: TransformerConfig, rng=None,
+               train: bool = True):
+    """Mean of start/end-position cross-entropies (BertForQuestionAnswering
+    objective). batch: tokens, segments (question=0/context=1),
+    start_positions [B], end_positions [B], optional pad_mask."""
+    s_logits, e_logits = qa_forward(params, qa_params, batch["tokens"], cfg,
+                                    segments=batch.get("segments"),
+                                    pad_mask=batch.get("pad_mask"),
+                                    rng=rng, train=train)
+
+    def ce(logits, pos):
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, pos[:, None], axis=1)[:, 0]
+        return jnp.mean(logz - gold)
+
+    return 0.5 * (ce(s_logits, batch["start_positions"])
+                  + ce(e_logits, batch["end_positions"]))
+
+
+def make_qa_train_step(cfg: TransformerConfig, updater):
+    """Fine-tune step over (encoder params, qa head) jointly — the
+    configs[4] workload. Shard with the same partition_specs; the head is
+    replicated (2 columns shard nothing)."""
+
+    def step(params, qa_params, opt_state, qa_opt_state, batch, iteration, rng):
+        def lf(p, q):
+            return qa_loss_fn(p, q, batch, cfg, rng, True)
+
+        loss, (g_p, g_q) = jax.value_and_grad(lf, argnums=(0, 1))(params, qa_params)
+        upd_p, new_opt = updater.apply(g_p, opt_state, params, iteration, 0)
+        upd_q, new_qopt = updater.apply(g_q, qa_opt_state, qa_params, iteration, 0)
+        new_params = jax.tree.map(lambda p, u: (p - u).astype(p.dtype), params, upd_p)
+        new_qa = jax.tree.map(lambda p, u: p - u, qa_params, upd_q)
+        return new_params, new_qa, new_opt, new_qopt, loss
 
     return step

@@ -168,15 +168,19 @@ class TestVideoReaders:
         from deeplearning4j_tpu.data.records import FileSplit
 
         rs = np.random.RandomState(1)
-        for vid in ("a", "b"):
-            d = tmp_path / vid
-            d.mkdir()
-            for t in range(4):
-                Image.fromarray(rs.randint(0, 255, (6, 6, 3), dtype=np.uint8)).save(
-                    str(d / f"{t:03d}.png"))
+        # class dirs above clips; 12 frames named by ffmpeg's %d convention
+        # (1..12 unpadded: a lexicographic sort would scramble them)
+        for cls, vid in (("walk", "clip1"), ("run", "clip1")):
+            d = tmp_path / cls / vid
+            d.mkdir(parents=True)
+            for t in range(1, 13):
+                Image.fromarray(np.full((6, 6, 3), t, dtype=np.uint8)).save(
+                    str(d / f"{t}.png"))
         rr = FrameDirectoryRecordReader(6, 6, 3).initialize(FileSplit(str(tmp_path)))
-        assert rr.labels() == ["a", "b"]
+        assert rr.labels() == ["run", "walk"]   # class vocab, no clip collision
         seq, lab = rr.next()
-        assert seq.shape == (4, 3, 6, 6) and lab == 0
+        assert seq.shape == (12, 3, 6, 6)
+        # natural frame order: frame t has constant pixel value t
+        np.testing.assert_allclose(seq[:, 0, 0, 0], np.arange(1, 13))
         seq2, lab2 = rr.next()
-        assert lab2 == 1 and not rr.has_next()
+        assert {lab, lab2} == {0, 1} and not rr.has_next()

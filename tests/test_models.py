@@ -119,3 +119,51 @@ def test_graft_entry():
     m = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(m)
     m.dryrun_multichip(8)
+
+
+class TestSquadFineTune:
+    """BASELINE configs[4] shape: BERT span-prediction fine-tune."""
+
+    def test_qa_head_learns_spans(self):
+        import jax
+
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerConfig, init_params, init_qa_head,
+            make_qa_train_step, qa_forward,
+        )
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        cfg = TransformerConfig.tiny(dropout=0.0)
+        params = init_params(jax.random.key(0), cfg)
+        qa = init_qa_head(jax.random.key(1), cfg)
+        updater = Adam(5e-3)
+        opt, qopt = updater.init(params), updater.init(qa)
+        step = jax.jit(make_qa_train_step(cfg, updater),
+                       donate_argnums=(0, 1, 2, 3))
+
+        rs = np.random.RandomState(0)
+        B, T = 8, 24
+        toks = rs.randint(3, cfg.vocab_size, (B, T)).astype(np.int32)
+        # answer span marked by sentinel tokens 1 (start) and 2 (end)
+        starts = rs.randint(1, T - 4, B).astype(np.int32)
+        ends = (starts + rs.randint(1, 3, B)).astype(np.int32)
+        for b in range(B):
+            toks[b, starts[b]] = 1
+            toks[b, ends[b]] = 2
+        segs = np.zeros((B, T), np.int32)
+        batch = {"tokens": jnp.asarray(toks), "segments": jnp.asarray(segs),
+                 "start_positions": jnp.asarray(starts),
+                 "end_positions": jnp.asarray(ends)}
+        rng = jax.random.key(2)
+        first = None
+        for i in range(120):
+            params, qa, opt, qopt, loss = step(params, qa, opt, qopt, batch,
+                                               jnp.asarray(i, jnp.int32), rng)
+            if i == 0:
+                first = float(loss)
+        last = float(loss)
+        assert last < first * 0.2, (first, last)
+        s_log, e_log = qa_forward(params, qa, batch["tokens"], cfg,
+                                  segments=batch["segments"])
+        acc = float(np.mean(np.argmax(np.asarray(s_log), -1) == starts))
+        assert acc > 0.7, acc
