@@ -1000,3 +1000,187 @@ def _isclose(a, b, rtol=1e-5, atol=1e-8):
 @op("approx_equal")
 def _approx_equal(a, b, tolerance=1e-5):
     return jnp.abs(a - b) < tolerance
+
+
+# ---------------------------------------------------------- wave 3b (r4 tail)
+# More of the generic corpus: morphology, scatter_nd family, quantization,
+# shape/meta utilities (ref: ops/declarable/generic/** rows not yet covered).
+
+
+def _morph_patches(x, kernel, strides, rates, padding):
+    """Shared window extraction for the morphology pair: returns
+    (patches [B,C,KH*KW,OH,OW], kernel_flat [C,KH*KW])."""
+    x = jnp.asarray(x)
+    kernel = jnp.asarray(kernel)
+    kh, kw, C = kernel.shape
+    patches = lax.conv_general_dilated_patches(
+        jnp.transpose(x, (0, 3, 1, 2)), (kh, kw), tuple(strides), padding,
+        rhs_dilation=tuple(rates))
+    B, _, OH, OW = patches.shape
+    p = patches.reshape(B, C, kh * kw, OH, OW)
+    kflat = jnp.transpose(kernel, (2, 0, 1)).reshape(C, kh * kw)
+    return p, kflat
+
+
+@op("dilation2d")
+def _dilation2d(x, kernel, strides=(1, 1), rates=(1, 1), padding="VALID"):
+    """Grayscale morphological dilation (TF semantics): x [B,H,W,C],
+    kernel [KH,KW,C]; out[p] = max over window (x + kernel)."""
+    p, kflat = _morph_patches(x, kernel, strides, rates, padding)
+    out = jnp.max(p + kflat[None, :, :, None, None], axis=2)
+    return jnp.transpose(out, (0, 2, 3, 1))
+
+
+@op("erosion2d")
+def _erosion2d(x, kernel, strides=(1, 1), rates=(1, 1), padding="VALID"):
+    """Morphological erosion, TF semantics: min over window of
+    (x - SPATIALLY-FLIPPED kernel) — erosion2d(x,k) is the dual
+    -dilation2d(-x, flip(k))."""
+    kernel = jnp.asarray(kernel)[::-1, ::-1, :]
+    p, kflat = _morph_patches(x, kernel, strides, rates, padding)
+    out = jnp.min(p - kflat[None, :, :, None, None], axis=2)
+    return jnp.transpose(out, (0, 2, 3, 1))
+
+
+@op("fake_quant_with_min_max_vars")
+def _fake_quant(x, min_val, max_val, num_bits=8, narrow_range=False):
+    """Simulated quantization (quantization-aware training forward)."""
+    qmin = 1.0 if narrow_range else 0.0
+    qmax = float(2 ** num_bits - 1)
+    scale = (max_val - min_val) / (qmax - qmin)
+    degenerate = scale == 0
+    scale = jnp.where(degenerate, 1.0, scale)  # avoid 0-div; masked below
+    zero = qmin - min_val / scale
+    zero = jnp.clip(jnp.round(zero), qmin, qmax)
+    q = jnp.clip(jnp.round(x / scale + zero), qmin, qmax)
+    return jnp.where(degenerate, 0.0, (q - zero) * scale)
+
+
+@op("is_numeric_tensor")
+def _is_numeric_tensor(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.number)
+
+
+@op("log_matrix_determinant")
+def _log_matrix_determinant(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet
+
+
+@op("matrix_set_diag")
+def _matrix_set_diag(x, diag):
+    x = jnp.asarray(x)
+    n = min(x.shape[-2], x.shape[-1])
+    idx = jnp.arange(n)
+    return x.at[..., idx, idx].set(jnp.asarray(diag)[..., :n])
+
+
+@op("mergemax_index")
+def _mergemax_index(*xs):
+    """Index of the input holding the elementwise max (ref mergemaxindex)."""
+    stacked = jnp.stack(xs)
+    return jnp.argmax(stacked, axis=0)
+
+
+@op("norm")
+def _norm(x, ord=2, dims=None, keepdims=False):
+    x = jnp.asarray(x)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=dims, keepdims=keepdims)
+    if ord == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=dims, keepdims=keepdims))
+    if ord in ("inf", np.inf):
+        return jnp.max(jnp.abs(x), axis=dims, keepdims=keepdims)
+    return jnp.sum(jnp.abs(x) ** ord, axis=dims, keepdims=keepdims) ** (1.0 / ord)
+
+
+@op("normalize_moments")
+def _normalize_moments(counts, mean_ss, variance_ss, shift=0.0):
+    """TF normalize_moments: sufficient statistics → (mean, variance)."""
+    divisor = 1.0 / jnp.maximum(counts, 1e-12)
+    shifted_mean = mean_ss * divisor
+    mean = shifted_mean + shift
+    variance = variance_ss * divisor - shifted_mean * shifted_mean
+    return mean, variance
+
+
+@op("sufficient_statistics")
+def _sufficient_statistics(x, dims, shift=0.0):
+    """TF sufficient_statistics: (count, mean_ss, var_ss, shift)."""
+    x = jnp.asarray(x)
+    dims = tuple(np.atleast_1d(dims).tolist())
+    count = float(np.prod([x.shape[d] for d in dims]))
+    m_ss = jnp.sum(x - shift, axis=dims)
+    v_ss = jnp.sum(jnp.square(x - shift), axis=dims)
+    return count, m_ss, v_ss, shift
+
+
+@op("random_crop")
+def _random_crop(key, x, size):
+    """Uniform-corner crop to ``size`` (ref random_crop)."""
+    x = jnp.asarray(x)
+    size = tuple(size)
+    starts = []
+    for d, (full, want) in enumerate(zip(x.shape, size)):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, full - want + 1))
+    return lax.dynamic_slice(x, starts, size)
+
+
+@op("scatter_nd")
+def _scatter_nd(indices, updates, shape):
+    indices = jnp.asarray(indices, jnp.int32)
+    out = jnp.zeros(tuple(shape), jnp.asarray(updates).dtype)
+    return out.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+@op("scatter_nd_add")
+def _scatter_nd_add(ref, indices, updates):
+    indices = jnp.asarray(indices, jnp.int32)
+    return jnp.asarray(ref).at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+@op("scatter_nd_update")
+def _scatter_nd_update(ref, indices, updates):
+    indices = jnp.asarray(indices, jnp.int32)
+    return jnp.asarray(ref).at[tuple(jnp.moveaxis(indices, -1, 0))].set(updates)
+
+
+@op("size_at")
+def _size_at(x, dim):
+    return jnp.shape(x)[dim]
+
+
+@op("compare_and_bitpack")
+def _compare_and_bitpack(x, threshold):
+    """TF compare_and_bitpack: last dim (divisible by 8) packed into uint8."""
+    bits = (jnp.asarray(x) > threshold).astype(jnp.uint8)
+    b = bits.reshape(bits.shape[:-1] + (bits.shape[-1] // 8, 8))
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    return jnp.sum(b * weights, axis=-1).astype(jnp.uint8)
+
+
+@op("bitcast")
+def _bitcast(x, dtype):
+    return lax.bitcast_convert_type(jnp.asarray(x), dtype)
+
+
+@op("broadcast_dynamic_shape")
+def _broadcast_dynamic_shape(a, b):
+    """Numpy broadcast of two shape VECTORS — jnp ops only, so it traces
+    (shape vectors can be computed tensors under jit)."""
+    a = jnp.asarray(a, jnp.int64)
+    b = jnp.asarray(b, jnp.int64)
+    n = max(a.shape[0], b.shape[0])
+    ap = jnp.concatenate([jnp.ones((n - a.shape[0],), jnp.int64), a])
+    bp = jnp.concatenate([jnp.ones((n - b.shape[0],), jnp.int64), b])
+    return jnp.where(ap == 1, bp, jnp.where(bp == 1, ap, jnp.maximum(ap, bp)))
+
+
+@op("mean_pairwssqerr_loss")
+def _mean_pairwssqerr(labels, preds):
+    """nd4j mean_pairwssqerr: mean squared difference of all PAIRWISE
+    differences per sample (pairwise-ranking-flavored regression loss)."""
+    d = (jnp.asarray(preds) - jnp.asarray(labels))
+    pair = d[:, :, None] - d[:, None, :]
+    return jnp.mean(jnp.square(pair))

@@ -881,6 +881,118 @@ CASES.update({
 })
 
 
+# ---------------------------------------------------------- corpus wave 3b
+
+_MORPH_X = R.rand(1, 6, 6, 2).astype(np.float32)
+_MORPH_K = (R.rand(3, 3, 2) * 0.1).astype(np.float32)
+
+
+def _np_dilation2d(x, k):
+    B, H, W, C = x.shape
+    kh, kw, _ = k.shape
+    oh, ow = H - kh + 1, W - kw + 1
+    out = np.zeros((B, oh, ow, C), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            win = x[:, i:i + kh, j:j + kw, :] + k[None]
+            out[:, i, j] = win.reshape(B, -1, C).max(1)
+    return out
+
+
+def _np_erosion2d(x, k):
+    k = k[::-1, ::-1, :]  # TF: erosion uses the spatially-flipped kernel
+    B, H, W, C = x.shape
+    kh, kw, _ = k.shape
+    oh, ow = H - kh + 1, W - kw + 1
+    out = np.zeros((B, oh, ow, C), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            win = x[:, i:i + kh, j:j + kw, :] - k[None]
+            out[:, i, j] = win.reshape(B, -1, C).min(1)
+    return out
+
+
+def _np_pairwssqerr(labels, preds):
+    # independent loop form: mean over samples and ALL ordered (i,j) pairs
+    total, cnt = 0.0, 0
+    for b in range(labels.shape[0]):
+        d = preds[b] - labels[b]
+        for i in range(len(d)):
+            for j in range(len(d)):
+                total += (d[i] - d[j]) ** 2
+                cnt += 1
+    return total / cnt
+
+
+_SND_IDX = np.array([[0], [2]], np.int32)
+_SND_UPD = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+
+CASES.update({
+    "dilation2d": ((_MORPH_X, _MORPH_K), {},
+                   _np_dilation2d(_MORPH_X, _MORPH_K), (0,)),
+    "erosion2d": ((_MORPH_X, _MORPH_K), {},
+                  _np_erosion2d(_MORPH_X, _MORPH_K), ()),  # min-kink: no fd grad
+    # TF semantics: zero point nudged to the integer grid first. The zero
+    # point is derived the same way the op does (0 - min/scale sits on a
+    # float tie at exactly 127.5; fp64 rounding picks the grid) and the
+    # x-quantization is checked independently in float32
+    "fake_quant_with_min_max_vars": ((A, -1.0, 1.0), {},
+                                     lambda out, args: np.testing.assert_allclose(
+                                         np.asarray(out),
+                                         (lambda z: (np.clip(np.round(
+                                             A / np.float32(2 / 255) + np.float32(z)),
+                                             0, 255) - np.float32(z))
+                                          * np.float32(2 / 255))(
+                                             np.clip(np.round(
+                                                 -np.float32(-1.0)
+                                                 / np.float32(2 / 255)), 0, 255)),
+                                         rtol=1e-4, atol=1e-5), ()),
+    "is_numeric_tensor": ((A,), {}, True, ()),
+    "log_matrix_determinant": ((SPD,), {},
+                               lambda out, args: np.testing.assert_allclose(
+                                   float(out[0]) * np.exp(float(out[1])),
+                                   np.linalg.det(SPD), rtol=1e-4), ()),
+    "matrix_set_diag": ((SQ, np.array([9.0, 8, 7], np.float32)), {},
+                        SQ - np.diag(np.diag(SQ)) + np.diag([9.0, 8, 7]), (0,)),
+    "mergemax_index": ((A, B, A + 10), {}, np.full_like(A, 2, dtype=np.int64), ()),
+    "norm": ((A,), dict(ord=1, dims=1), np.abs(A).sum(1), ()),
+    "normalize_moments": ((3.0, A.sum(0), (A * A).sum(0)), {},
+                          lambda out, args: (
+                              np.testing.assert_allclose(np.asarray(out[0]), A.mean(0),
+                                                         rtol=1e-5, atol=1e-6),
+                              np.testing.assert_allclose(np.asarray(out[1]), A.var(0),
+                                                         rtol=1e-4, atol=1e-5)), ()),
+    "sufficient_statistics": ((A, 0), {},
+                              lambda out, args: (
+                                  np.testing.assert_allclose(out[0], 3.0),
+                                  np.testing.assert_allclose(np.asarray(out[1]),
+                                                             A.sum(0), rtol=1e-5),
+                                  np.testing.assert_allclose(np.asarray(out[2]),
+                                                             (A * A).sum(0),
+                                                             rtol=1e-5)), ()),
+    "random_crop": ((jax.random.key(0), IMG, (2, 3, 4, 4)), {},
+                    lambda out, args: np.asarray(out).shape == (2, 3, 4, 4), ()),
+    "scatter_nd": ((_SND_IDX, _SND_UPD, (4, 2)), {},
+                   np.array([[1.0, 2], [0, 0], [3, 4], [0, 0]]), ()),
+    "scatter_nd_add": ((np.ones((4, 2), np.float32), _SND_IDX, _SND_UPD), {},
+                       np.array([[2.0, 3], [1, 1], [4, 5], [1, 1]]), ()),
+    "scatter_nd_update": ((np.ones((4, 2), np.float32), _SND_IDX, _SND_UPD), {},
+                          np.array([[1.0, 2], [1, 1], [3, 4], [1, 1]]), ()),
+    "size_at": ((IMG, 2), {}, 6, ()),
+    "compare_and_bitpack": ((np.array([[1, -1, 1, 1, -1, -1, -1, 1]], np.float32),
+                             0.0), {}, np.array([[0b10110001]], np.uint8), ()),
+    "bitcast": ((np.array([1.0], np.float32), jnp.int32), {},
+                np.array([1.0], np.float32).view(np.int32), ()),
+    "broadcast_dynamic_shape": ((np.array([3, 1], np.int64),
+                                 np.array([1, 4], np.int64)), {},
+                                np.array([3, 4]), ()),
+    "mean_pairwssqerr_loss": ((A, B), {},
+                              lambda out, args: np.testing.assert_allclose(
+                                  float(out), _np_pairwssqerr(A, B),
+                                  rtol=1e-5), (1,)),
+})
+
+
 @pytest.mark.parametrize("name", sorted(OPS))
 def test_op_forward(name):
     assert name in CASES, (
