@@ -113,9 +113,12 @@ class NDArray:
         return a.astype(dtype) if dtype is not None else a
 
     def _set_value(self, new_buf) -> "NDArray":
-        """Route a full-value replacement through the root buffer (aliasing)."""
+        """Route a full-value replacement through the root buffer (aliasing).
+        In-place semantics preserve the array's dtype (DL4J divi on ints
+        truncates; it never silently promotes the buffer) — both branches
+        cast, so owners and views behave identically."""
         if self._root is None:
-            self._buf = new_buf if isinstance(new_buf, jax.Array) else jnp.asarray(new_buf)
+            self._buf = jnp.asarray(new_buf, self._buf.dtype)
         else:
             root = self._root
             root._buf = root._buf.at[self._index].set(jnp.asarray(new_buf, root._buf.dtype))
@@ -537,6 +540,18 @@ class NDArray:
     def addi_column_vector(self, v):
         return self._set_value(self.add_column_vector(v).jax)
 
+    def subi_row_vector(self, v):
+        return self._set_value(self.sub_row_vector(v).jax)
+
+    def subi_column_vector(self, v):
+        return self._set_value(self.sub_column_vector(v).jax)
+
+    def divi_row_vector(self, v):
+        return self._set_value(self.div_row_vector(v).jax)
+
+    def divi_column_vector(self, v):
+        return self._set_value(self.div_column_vector(v).jax)
+
     def muli_row_vector(self, v):
         return self._set_value(self.mul_row_vector(v).jax)
 
@@ -702,6 +717,68 @@ class NDArray:
         return float(jnp.sum(jnp.square(d)))
 
     squaredDistance = squared_distance
+
+    def rows(self) -> int:
+        """INDArray.rows(): matrix row count; 1 for a rank-1 (row) vector."""
+        if self.rank == 1:
+            return 1
+        if self.rank != 2:
+            raise ValueError(f"rows() requires rank <= 2, got rank {self.rank}")
+        return self.shape[0]
+
+    def columns(self) -> int:
+        """INDArray.columns(): matrix column count; length for a rank-1 vector."""
+        if self.rank == 1:
+            return self.shape[0]
+        if self.rank != 2:
+            raise ValueError(f"columns() requires rank <= 2, got rank {self.rank}")
+        return self.shape[1]
+
+    def is_square(self) -> bool:
+        return self.rank == 2 and self.shape[0] == self.shape[1]
+
+    isSquare = is_square
+
+    def _to_vector(self, dtype):
+        if not self.is_vector() and self.rank != 1:
+            raise ValueError(
+                f"to*Vector() requires a vector, got shape {self.shape}")
+        # ravel() respects this array's 'c'/'f' order, so extraction agrees
+        # with flatten()/ravel() on the same object
+        return np.asarray(self.ravel().jax, dtype)
+
+    def to_double_vector(self):
+        """INDArray.toDoubleVector(): host float64 1-D (vector input only,
+        like the reference's IllegalStateException on wrong rank)."""
+        return self._to_vector(np.float64)
+
+    toDoubleVector = to_double_vector
+
+    def to_float_vector(self):
+        return self._to_vector(np.float32)
+
+    toFloatVector = to_float_vector
+
+    def to_int_vector(self):
+        return self._to_vector(np.int32)
+
+    toIntVector = to_int_vector
+
+    def _to_matrix(self, dtype):
+        if self.rank != 2:
+            raise ValueError(
+                f"to*Matrix() requires rank 2, got shape {self.shape}")
+        return np.asarray(self.jax, dtype)
+
+    def to_double_matrix(self):
+        return self._to_matrix(np.float64)
+
+    toDoubleMatrix = to_double_matrix
+
+    def to_float_matrix(self):
+        return self._to_matrix(np.float32)
+
+    toFloatMatrix = to_float_matrix
 
     def median_number(self) -> float:
         return float(jnp.median(self.jax))

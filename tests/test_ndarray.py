@@ -417,3 +417,43 @@ class TestJ1Wave3:
         np.testing.assert_allclose(s.numpy(), [[0.5, 0.5]])
         np.testing.assert_allclose(
             T.sort(np.array([3.0, 1.0, 2.0]), descending=True).numpy(), [3, 2, 1])
+
+
+class TestJ1Wave4:
+    def test_inplace_rowcol_tail(self):
+        a = NDArray(np.ones((2, 3), np.float32) * 6)
+        a.subi_row_vector(np.array([1.0, 2, 3]))
+        np.testing.assert_allclose(a.numpy(), [[5, 4, 3], [5, 4, 3]])
+        a.divi_column_vector(np.array([1.0, 2.0]))
+        np.testing.assert_allclose(a.numpy(), [[5, 4, 3], [2.5, 2, 1.5]])
+
+    def test_shape_accessors_and_conversions(self):
+        a = NDArray(np.arange(6.0, dtype=np.float32).reshape(2, 3))
+        assert a.rows() == 2 and a.columns() == 3 and not a.is_square()
+        assert NDArray(np.eye(3, dtype=np.float32)).is_square()
+        # rank-1 = row vector (DL4J): rows()=1, columns()=length
+        v1 = NDArray(np.ones(5, np.float32))
+        assert v1.rows() == 1 and v1.columns() == 5
+        row = NDArray(np.arange(6.0, dtype=np.float32).reshape(1, 6))
+        v = row.to_double_vector()
+        assert v.dtype == np.float64 and v.shape == (6,)
+        assert row.to_int_vector().tolist() == [0, 1, 2, 3, 4, 5]
+        m = a.to_float_matrix()
+        assert m.dtype == np.float32 and m.shape == (2, 3)
+        np.testing.assert_allclose(a.to_double_matrix(), a.numpy())
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="Vector"):
+            a.to_double_vector()
+        with _pytest.raises(ValueError, match="Matrix"):
+            v1.to_float_matrix()
+
+    def test_inplace_keeps_dtype_owner_and_view(self):
+        a = NDArray(np.array([[4, 5]], np.int32))
+        a.divi_row_vector(np.array([2, 2]))
+        assert a.numpy().dtype == np.int32
+        np.testing.assert_array_equal(a.numpy(), [[2, 2]])  # truncating divi
+        big = NDArray(np.full((2, 2), 9, np.int32))
+        view = big.get_row(0)
+        view.divi(2)
+        assert big.numpy().dtype == np.int32
+        np.testing.assert_array_equal(big.numpy(), [[4, 4], [9, 9]])
