@@ -869,3 +869,4 @@ def _rshuffle(rng, x):
 # space-batch, linalg tail, skipgram/cbow training ops) registers itself into
 # this same table on import — keep last so the decorator sees a full module.
 from . import ops_wave3  # noqa: E402,F401  (registration side effect)
+from . import ops_wave4  # noqa: E402,F401  (registration side effect)

@@ -26,12 +26,16 @@ _NEG = -1e30
 
 
 @op("ctc_loss")
-def _ctc_loss(labels, logits, label_lengths, logit_lengths, blank=0):
-    """CTC negative log-likelihood, mean over batch.
+def _ctc_loss(labels, logits, label_lengths, logit_lengths, blank=0,
+              reduction="mean"):
+    """CTC negative log-likelihood.
 
     labels [B,S] int32, logits [B,T,C] raw scores, lengths [B].
     Log-space alpha recursion as one ``lax.scan`` over time (ref:
     generic/loss/ctcLoss.cpp); fully differentiable w.r.t. logits.
+    ``reduction``: 'mean' (batch mean, the DL4J loss-layer contract) or
+    'none' for the per-example [B] vector TF ctc_loss returns (ADVICE r4:
+    per-example weighting callers need the vector).
     """
     labels = jnp.asarray(labels, jnp.int32)
     logits = jnp.asarray(logits)
@@ -69,6 +73,8 @@ def _ctc_loss(labels, logits, label_lengths, logit_lengths, blank=0):
     a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
     a_last = jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
     ll = jnp.logaddexp(a_end, jnp.where(label_lengths > 0, a_last, _NEG))
+    if reduction == "none":
+        return -ll
     return -jnp.mean(ll)
 
 
@@ -230,14 +236,28 @@ def _useg(reducer, init, x, ids, num_segments):
     return reducer(out, ids, x)
 
 
+def _dtype_extreme(dtype, lowest):
+    """TF parity: empty segments get the dtype's lowest/highest value —
+    works for int dtypes too (ADVICE r4: jnp.full(±inf) raises on ints)."""
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return info.min if lowest else info.max
+    return -jnp.inf if lowest else jnp.inf
+
+
 @op("unsorted_segment_max")
 def _unsorted_segment_max(x, ids, num_segments):
-    return _useg(lambda o, i, v: o.at[i].max(v, mode="drop"), -jnp.inf, x, ids, num_segments)
+    x = jnp.asarray(x)
+    return _useg(lambda o, i, v: o.at[i].max(v, mode="drop"),
+                 _dtype_extreme(x.dtype, lowest=True), x, ids, num_segments)
 
 
 @op("unsorted_segment_min")
 def _unsorted_segment_min(x, ids, num_segments):
-    return _useg(lambda o, i, v: o.at[i].min(v, mode="drop"), jnp.inf, x, ids, num_segments)
+    x = jnp.asarray(x)
+    return _useg(lambda o, i, v: o.at[i].min(v, mode="drop"),
+                 _dtype_extreme(x.dtype, lowest=False), x, ids, num_segments)
 
 
 @op("unsorted_segment_prod")
@@ -637,7 +657,9 @@ def _cbow(syn0, syn1neg, context_window, target, negatives, lr=0.025):
     w = syn1neg[targets]
     logits = jnp.einsum("bd,bkd->bk", h, w)
     g = (jax.nn.sigmoid(logits) - labels) * lr
-    dh = jnp.einsum("bk,bkd->bd", g, w) / W               # spread over window
+    # word2vec.c / sg_cb.cpp apply the accumulated neu1e to EVERY context row
+    # undivided (no 1/W), even though h averaged over the window (ADVICE r4)
+    dh = jnp.einsum("bk,bkd->bd", g, w)
     dw = g[..., None] * h[:, None, :]
     new_syn0 = syn0.at[ctx.reshape(-1)].add(-jnp.repeat(dh, W, axis=0))
     new_syn1 = syn1neg.at[targets.reshape(-1)].add(-dw.reshape(-1, dw.shape[-1]))
@@ -899,9 +921,10 @@ def _split_v(x, sizes, axis=0):
 @op("batch_gather")
 def _batch_gather(x, indices):
     """Gather along axis 1 with a leading shared batch dim."""
+    x = jnp.asarray(x)
+    idx = jnp.asarray(indices, jnp.int32)  # before .shape: plain lists work too
     return jnp.take_along_axis(
-        x, jnp.asarray(indices, jnp.int32).reshape(indices.shape + (1,) * (x.ndim - indices.ndim)),
-        axis=1)
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - idx.ndim)), axis=1)
 
 
 @op("logspace")

@@ -1,0 +1,1161 @@
+"""Op corpus wave 4 — closes the N6 tail named by VERDICT r4 missing #1.
+
+Reference analog: ``libnd4j/include/ops/declarable/generic/**`` (SURVEY §2.1
+N6). This wave lands the remaining named families:
+
+- convolution/pooling tail (deconv3d, sconv2d, 1-D pools/upsampling,
+  pointwise/pnorm pools, ismax) — generic/nn/convo/**
+- the RNN compat family (lstm_block_cell, static/dynamic[/bidirectional]
+  RNN, sru_bi) — generic/nn/recurrent/**
+- the updater op family (sgd_updater … adabelief_updater, apply_sgd) —
+  generic/updaters/**, generic/nn/apply_sgd.cpp
+- NDArrayList / TensorArray ops (create_list … delete_list) — generic/list/**
+- Barnes-Hut tSNE helpers (barnes_gains, barnes_edge_forces,
+  barnes_symmetrized, cell_contains, knn_mindistance) — generic/datatypes
+  + helpers/BarnesHutTsne (SURVEY §2.5 P5)
+- gradient-compression codec ops (encode/decode_threshold, encode/
+  decode_bitmap) — generic/compression/** (same wire semantics as
+  ``native/tnd.cpp``; SURVEY §2.1 N15)
+- image tail (image_resize, draw_bounding_boxes, yiq/yuv conversions,
+  NMS-with-overlaps, adjust_contrast_v2) — generic/images/**
+- bit ops (toggle_bits, bits_hamming_distance, shift_bits, hashcode) —
+  generic/bitwise/** (declarable "helpers/hashcode")
+- TF-compat tail (compat_sparse_to_dense, compat_string_split, select,
+  where_np, choose, identity_n, multinomial) — generic/compat/**,
+  generic/parity_ops/**
+- linalg tail (eig, logdet, solve_ls) — generic/linalg/**
+- the reference-canonical registry spellings (avgpool2d, maxpool3dnew,
+  conv3dnew, batchnorm, *_loss names) that differ from the TF-flavoured
+  aliases registered in earlier waves — both names resolve, as both are
+  probe-able registry vocabulary upstream.
+
+Every op is a jax-traceable callable except the explicitly host-side ones
+(eig, compat_string_split, barnes_symmetrized, the list container family),
+mirroring the reference's CPU-helper pattern. The build-failing coverage
+gate in tests/test_op_validation.py applies to every name added here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ops_registry import OPS, op
+
+# --------------------------------------------------------- conv / pool tail
+
+
+@op("deconv3d")
+def _deconv3d(x, w, stride=(2, 2, 2), padding="SAME"):
+    """3-D transposed convolution, NCDHW / IODHW kernel (ref: generic/nn/
+    convo/deconv3d.cpp; same kernel convention as the 2-D deconv2d op)."""
+    return lax.conv_transpose(x, w, strides=tuple(stride), padding=padding,
+                              dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
+
+
+@op("sconv2d")
+def _sconv2d(x, depth_w, point_w=None, b=None, stride=(1, 1), padding="SAME"):
+    """Separable conv2d, nd4j spelling (ref: generic/nn/convo/sconv2d.cpp):
+    depthwise [C*M, 1, kH, kW] then optional 1x1 pointwise [O, C*M, 1, 1]."""
+    C = x.shape[1]
+    z = lax.conv_general_dilated(
+        x, depth_w, window_strides=tuple(stride), padding=padding,
+        feature_group_count=C, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if point_w is not None:
+        z = lax.conv_general_dilated(z, point_w, window_strides=(1, 1),
+                                     padding="VALID",
+                                     dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return z if b is None else z + b[None, :, None, None]
+
+
+@op("pointwise_conv2d")
+def _pointwise_conv2d(x, w, b=None):
+    """1x1 conv (ref: generic/nn/convo/pointwise_conv2d.cpp), NCHW/OIHW."""
+    z = lax.conv_general_dilated(x, w, window_strides=(1, 1), padding="VALID",
+                                 dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return z if b is None else z + b[None, :, None, None]
+
+
+@op("deconv2d_tf")
+def _deconv2d_tf(output_shape, w, x, stride=(2, 2), padding="SAME"):
+    """TF Conv2DBackpropInput flavour (ref: generic/nn/convo/deconv2d_tf.cpp):
+    first arg is the target output shape [N,C,H,W]; kernel IOHW like deconv2d."""
+    z = lax.conv_transpose(x, w, strides=tuple(stride), padding=padding,
+                           dimension_numbers=("NCHW", "IOHW", "NCHW"))
+    tgt = tuple(int(d) for d in np.asarray(output_shape).reshape(-1))
+    if tuple(z.shape) != tgt:
+        raise ValueError(f"deconv2d_tf produced {z.shape}, expected {tgt}")
+    return z
+
+
+@op("max_pool1d")
+@op("maxpool1d")
+def _max_pool1d(x, kernel=2, stride=2, padding="VALID"):
+    """[N, C, W] max pool (ref: generic/nn/convo/pooling/maxpool1d? — the
+    1-D pools lower to 2-D with a unit height upstream; same here)."""
+    k = kernel if isinstance(kernel, int) else kernel[0]
+    s = stride if isinstance(stride, int) else stride[0]
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, k), (1, 1, s), padding)
+
+
+@op("avg_pool1d")
+@op("avgpool1d")
+def _avg_pool1d(x, kernel=2, stride=2, padding="VALID"):
+    k = kernel if isinstance(kernel, int) else kernel[0]
+    s = stride if isinstance(stride, int) else stride[0]
+    sm = lax.reduce_window(x, 0.0, lax.add, (1, 1, k), (1, 1, s), padding)
+    c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, (1, 1, k), (1, 1, s), padding)
+    return sm / c
+
+
+@op("upsampling1d")
+def _upsampling1d(x, scale=2):
+    """[N, C, W] nearest-neighbour repeat (ref: generic/nn/convo/upsampling1d.cpp)."""
+    return jnp.repeat(x, scale, axis=2)
+
+
+@op("pnormpool2d")
+def _pnormpool2d(x, kernel=(2, 2), stride=(2, 2), padding="VALID", p=2.0):
+    """p-norm pooling (ref: generic/nn/convo/pooling/pnormpool2d.cpp)."""
+    s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, (1, 1) + tuple(kernel),
+                         (1, 1) + tuple(stride), padding)
+    return s ** (1.0 / p)
+
+
+@op("ismax")
+def _ismax(x, axis=None):
+    """One-hot of the (global or per-axis) argmax (legacy transform IsMax)."""
+    if axis is None:
+        flat = x.reshape(-1)
+        hot = jnp.zeros_like(flat).at[jnp.argmax(flat)].set(1)
+        return hot.reshape(x.shape)
+    idx = jnp.argmax(x, axis=axis, keepdims=True)
+    return (jnp.arange(x.shape[axis]).reshape(
+        tuple(-1 if i == (axis % x.ndim) else 1 for i in range(x.ndim))) == idx
+    ).astype(x.dtype)
+
+
+# ----------------------------------------------------------------- rnn tail
+
+
+def _rnn_scan(x_tbi, h0, wx, wh, b, seq_len=None):
+    """Elman RNN (tanh) over time-major input — the static/dynamic RNN core
+    (ref: generic/nn/recurrent/staticRNN.cpp / dynamicRNN.cpp). With
+    seq_len, the carried state freezes at each row's last real step and the
+    OUTPUT is zero past it — the TF dynamic_rnn contract (r5 review)."""
+    T = x_tbi.shape[0]
+
+    def cell(h, inp):
+        x_t, t = inp
+        hn = jnp.tanh(x_t @ wx + h @ wh + b)
+        if seq_len is None:
+            return hn, hn
+        alive = (t < seq_len)[:, None]
+        hn = jnp.where(alive, hn, h)
+        return hn, jnp.where(alive, hn, 0.0)
+
+    hT, ys = lax.scan(cell, h0, (x_tbi, jnp.arange(T)))
+    return ys, hT
+
+
+@op("static_rnn")
+def _static_rnn(x, h0, wx, wh, b, seq_len=None):
+    """x [T,B,I] → (ys [T,B,H], h_T). seq_len [B] freezes finished rows."""
+    return _rnn_scan(x, h0, wx, wh, b, seq_len)
+
+
+@op("dynamic_rnn")
+def _dynamic_rnn(x, h0, wx, wh, b, seq_len=None, time_major=False):
+    """TF dynamicRNN flavour: batch-major [B,T,I] unless time_major."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    ys, hT = _rnn_scan(x, h0, wx, wh, b, seq_len)
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return ys, hT
+
+
+def _reverse_by_len(x_tbi, seq_len):
+    """reverse_sequence on a time-major [T,B,...] batch: row b reverses its
+    first seq_len[b] steps, padding stays in place (TF/DL4J bidirectional
+    semantics — a plain x[::-1] would feed the backward cell padding first
+    and never reach short rows' real data)."""
+    if seq_len is None:
+        return x_tbi[::-1]
+    T = x_tbi.shape[0]
+    t = jnp.arange(T)[:, None]                       # [T,1]
+    src = jnp.where(t < seq_len[None, :], seq_len[None, :] - 1 - t, t)  # [T,B]
+    return jnp.take_along_axis(
+        x_tbi, src.reshape(src.shape + (1,) * (x_tbi.ndim - 2)), axis=0)
+
+
+@op("static_bidirectional_rnn")
+def _static_bidirectional_rnn(x, h0f, h0b, wxf, whf, bf, wxb, whb, bb, seq_len=None):
+    """Forward + per-row-reversed backward pass, outputs concatenated on H
+    (ref: generic/nn/recurrent/staticBidirectionalRNN.cpp)."""
+    yf, hf = _rnn_scan(x, h0f, wxf, whf, bf, seq_len)
+    yb, hb = _rnn_scan(_reverse_by_len(x, seq_len), h0b, wxb, whb, bb, seq_len)
+    return jnp.concatenate([yf, _reverse_by_len(yb, seq_len)], axis=-1), hf, hb
+
+
+@op("dynamic_bidirectional_rnn")
+def _dynamic_bidirectional_rnn(x, h0f, h0b, wxf, whf, bf, wxb, whb, bb,
+                               seq_len=None, time_major=False):
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    ys, hf, hb = _static_bidirectional_rnn(x, h0f, h0b, wxf, whf, bf, wxb, whb,
+                                           bb, seq_len)
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return ys, hf, hb
+
+
+@op("lstm_block_cell")
+def _lstm_block_cell(x, h_prev, c_prev, wx, wh, b, wci=None, wcf=None, wco=None,
+                     forget_bias=0.0):
+    """One lstmBlock step with optional peepholes + forget bias (ref:
+    generic/nn/recurrent/lstmBlockCell.cpp). Returns (h, c) — the
+    reference's seven intermediate outputs are recomputable from these and
+    exist upstream only to feed its op-by-op backward, which jax replaces."""
+    H = h_prev.shape[-1]
+    z = x @ wx + h_prev @ wh + b
+    i, f, g, o = z[..., :H], z[..., H:2 * H], z[..., 2 * H:3 * H], z[..., 3 * H:]
+    if wci is not None:
+        i = i + c_prev * wci
+        f = f + c_prev * wcf
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+    if wco is not None:
+        o = o + c * wco
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+@op("sru_bi")
+def _sru_bi(x, c0f, c0b, w, wf, wr, bf, br, wb, wfb, wrb, bfb, brb):
+    """Bidirectional SRU (ref: generic/nn/recurrent/sru.cpp sru_bi):
+    forward + reversed backward cell, H-concatenated. x [T,B,I]."""
+    fwd = OPS["sru"]
+    hf, cf = fwd(x, c0f, w, wf, wr, bf, br)
+    hb, cb = fwd(x[::-1], c0b, wb, wfb, wrb, bfb, brb)
+    return jnp.concatenate([hf, hb[::-1]], axis=-1), cf, cb
+
+
+# -------------------------------------------------------------- random tail
+
+
+@op("multinomial")
+def _multinomial(key, logits, num_samples):
+    """TF Multinomial compat spelling (ref: generic/random/multinomial.cpp);
+    same sampler as random_multinomial."""
+    return OPS["random_multinomial"](key, logits, num_samples)
+
+
+@op("alpha_dropout")
+def _alpha_dropout(key, x, rate=0.1):
+    """SELU-preserving alpha dropout (legacy random op AlphaDropOut; the
+    DL4J AlphaDropout scheme): dropped units go to alpha', output is
+    affine-corrected to keep mean/variance."""
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    a = (keep + alpha_p ** 2 * keep * rate) ** -0.5
+    bcoef = -a * alpha_p * rate
+    return a * jnp.where(mask, x, alpha_p) + bcoef
+
+
+@op("dropout_inverted")
+def _dropout_inverted(key, x, rate=0.5):
+    """Inverted dropout (legacy random op DropOutInverted): survivors scaled
+    by 1/keep at train time so inference is identity."""
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+@op("get_seed")
+def _get_seed():
+    """Current stateful-RNG seed (ref: generic/random/get_seed.cpp via the
+    NativeOps RNG facade — here rng/random.py)."""
+    from ..rng.random import get_random
+
+    return np.int64(get_random().seed)
+
+
+@op("set_seed")
+def _set_seed(seed):
+    from ..rng.random import set_seed as _ss
+
+    _ss(int(seed))
+    return np.int64(seed)
+
+
+# --------------------------------------------------------------- image tail
+
+
+@op("image_resize")
+def _image_resize(images, size, method="bilinear", antialias=True):
+    """Umbrella resize op (ref: generic/images/image_resize.cpp), NHWC.
+
+    Supported kernels: bilinear/nearest/bicubic/lanczos3/lanczos5 (XLA
+    resize), plus exact 'area' (box mean) for integral downscales. The
+    reference's gaussian/mitchellcubic kernels have no XLA equivalent and
+    raise rather than silently substituting a different filter."""
+    methods = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic",
+               "lanczos3": "lanczos3", "lanczos5": "lanczos5"}
+    B, H, W, C = images.shape
+    h, w = (int(s) for s in np.asarray(size).reshape(-1))
+    if method == "area":
+        if H % h or W % w:
+            raise ValueError(
+                f"area resize supports integral downscale only, got {(H, W)}→{(h, w)}")
+        return jnp.asarray(images).reshape(B, h, H // h, w, W // w, C).mean((2, 4))
+    if method not in methods:
+        raise ValueError(f"unsupported resize method '{method}' "
+                         f"(supported: {sorted(methods)} + 'area')")
+    if method == "nearest":
+        antialias = False
+    return jax.image.resize(images, (B, h, w, C), methods[method],
+                            antialias=antialias)
+
+
+@op("draw_bounding_boxes")
+def _draw_bounding_boxes(images, boxes, colors=None):
+    """Paint 1-px box borders (ref: generic/images/draw_bounding_boxes.cpp).
+    images [B,H,W,C]; boxes [B,K,4] normalized (ymin,xmin,ymax,xmax);
+    colors [K,C] (cycled), default red-ish first channel."""
+    images = jnp.asarray(images)
+    B, H, W, C = images.shape
+    boxes = jnp.asarray(boxes)
+    K = boxes.shape[1]
+    if colors is None:
+        colors = jnp.zeros((K, C)).at[:, 0].set(1.0)
+    colors = jnp.asarray(colors)
+    yy = jnp.arange(H)[:, None]
+    xx = jnp.arange(W)[None, :]
+    out = images
+    for kbox in range(K):
+        y0 = jnp.round(boxes[:, kbox, 0] * (H - 1)).astype(jnp.int32)
+        x0 = jnp.round(boxes[:, kbox, 1] * (W - 1)).astype(jnp.int32)
+        y1 = jnp.round(boxes[:, kbox, 2] * (H - 1)).astype(jnp.int32)
+        x1 = jnp.round(boxes[:, kbox, 3] * (W - 1)).astype(jnp.int32)
+        inside = ((yy[None] >= y0[:, None, None]) & (yy[None] <= y1[:, None, None])
+                  & (xx[None] >= x0[:, None, None]) & (xx[None] <= x1[:, None, None]))
+        border = inside & ((yy[None] == y0[:, None, None]) | (yy[None] == y1[:, None, None])
+                           | (xx[None] == x0[:, None, None]) | (xx[None] == x1[:, None, None]))
+        color = colors[kbox % colors.shape[0]]
+        out = jnp.where(border[..., None], color, out)
+    return out
+
+
+_YIQ = np.array([[0.299, 0.587, 0.114],
+                 [0.5959, -0.2746, -0.3213],
+                 [0.2115, -0.5227, 0.3112]], np.float32)
+_YUV = np.array([[0.299, 0.587, 0.114],
+                 [-0.14714119, -0.28886916, 0.43601035],
+                 [0.61497538, -0.51496512, -0.10001026]], np.float32)
+
+
+@op("rgb_to_yiq")
+def _rgb_to_yiq(x):
+    """(ref: generic/images/rgb_to_yiq.cpp) — last axis is the channel."""
+    return x @ jnp.asarray(_YIQ).T
+
+
+@op("yiq_to_rgb")
+def _yiq_to_rgb(x):
+    return x @ jnp.asarray(np.linalg.inv(_YIQ)).T
+
+
+@op("rgb_to_yuv")
+def _rgb_to_yuv(x):
+    return x @ jnp.asarray(_YUV).T
+
+
+@op("yuv_to_rgb")
+def _yuv_to_rgb(x):
+    return x @ jnp.asarray(np.linalg.inv(_YUV)).T
+
+
+@op("adjust_contrast_v2")
+def _adjust_contrast_v2(x, factor):
+    """Per-channel-mean contrast scaling (ref: generic/images/
+    adjust_contrast.cpp, the _v2 TF-parity variant)."""
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+@op("non_max_suppression_overlaps")
+def _nms_overlaps(overlaps, scores, max_out, overlap_threshold=0.5,
+                  score_threshold=-jnp.inf):
+    """NMS on a precomputed [N,N] overlaps matrix (ref: generic/images/
+    non_max_suppression_overlaps.cpp). Returns (indices [max_out] padded
+    with -1, count)."""
+    overlaps = jnp.asarray(overlaps)
+    scores = jnp.asarray(scores)
+    N = scores.shape[0]
+    alive = scores > score_threshold
+
+    def body(carry, _):
+        alive, out, cnt = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = masked[best] > -jnp.inf
+        out = out.at[cnt].set(jnp.where(ok, best, -1))
+        cnt = cnt + ok.astype(jnp.int32)
+        suppress = overlaps[best] > overlap_threshold
+        alive = alive & ~suppress & ok
+        return (alive, out, cnt), None
+
+    out0 = jnp.full((max_out,), -1, jnp.int32)
+    (alive, out, cnt), _ = lax.scan(body, (alive, out0, jnp.int32(0)),
+                                    None, length=max_out)
+    return out, cnt
+
+
+# ----------------------------------------------------------------- bit ops
+
+
+@op("toggle_bits")
+def _toggle_bits(x):
+    """Bitwise NOT on integer buffers (ref: generic/bitwise/toggle_bits.cpp)."""
+    return jnp.invert(jnp.asarray(x))
+
+
+@op("shift_bits")
+def _shift_bits(x, shift):
+    """nd4j spelling of left shift (generic/bitwise/shift_bits.cpp)."""
+    return jnp.left_shift(jnp.asarray(x), shift)
+
+
+@op("rshift_bits")
+def _rshift_bits(x, shift):
+    return jnp.right_shift(jnp.asarray(x), shift)
+
+
+def _popcount32(v):
+    v = v - ((v >> 1) & 0x55555555)
+    v = (v & 0x33333333) + ((v >> 2) & 0x33333333)
+    v = (v + (v >> 4)) & 0x0F0F0F0F
+    return (v * 0x01010101) >> 24
+
+
+@op("bits_hamming_distance")
+def _bits_hamming_distance(a, b):
+    """Total differing BITS (ref: generic/bitwise/bits_hamming_distance.cpp)
+    — distinct from the elementwise 'hamming_distance' reduction."""
+    x = jnp.bitwise_xor(jnp.asarray(a, jnp.int32), jnp.asarray(b, jnp.int32))
+    return jnp.sum(_popcount32(x.astype(jnp.uint32)).astype(jnp.int64))
+
+
+@op("hashcode")
+def _hashcode(x):
+    """Deterministic buffer hash (ref: libnd4j helpers/hashcode.h — the
+    java-style 31·h + v fold over the raw int32 view). Computed in closed
+    form, h = 17·31^N + Σ v_i·31^(N−1−i) under wraparound arithmetic, so
+    the whole hash is one parallel cumprod + dot instead of an O(N)
+    sequential scan (r5 review)."""
+    v = jnp.asarray(x)
+    if v.dtype in (jnp.float32, jnp.float64, jnp.bfloat16, jnp.float16):
+        v = lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32)
+    v = v.astype(jnp.int64).reshape(-1)
+    n = v.shape[0]
+    if n == 0:
+        return jnp.int64(17)
+    base = jnp.full((n,), 31, v.dtype).at[0].set(1)
+    powers = jnp.flip(jnp.cumprod(base))          # 31^(N-1) … 31^0, wrapping
+    return jnp.int64(17) * powers[0] * jnp.asarray(31, v.dtype) + jnp.sum(v * powers)
+
+
+# -------------------------------------------------------------- compat tail
+
+
+@op("compat_sparse_to_dense")
+def _compat_sparse_to_dense(indices, shape, values, default=0):
+    """(ref: generic/compat/compat_sparse_to_dense.cpp) indices [N,R]."""
+    shape = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+    out = jnp.full(shape, default, dtype=jnp.asarray(values).dtype)
+    return out.at[tuple(jnp.asarray(indices, jnp.int32).T)].set(values)
+
+
+@op("compat_string_split")
+def _compat_string_split(strings, delimiter=" "):
+    """Host-side (string tensors never reach the device — the reference
+    runs this on CPU too; ref: generic/compat/compat_string_split.cpp).
+    Returns (indices [N,2], values list, dense_shape)."""
+    strings = np.asarray(strings).reshape(-1)
+    indices, values = [], []
+    max_c = 0
+    for i, s in enumerate(strings):
+        parts = str(s).split(delimiter) if delimiter else list(str(s))
+        parts = [p for p in parts if p != ""]
+        max_c = max(max_c, len(parts))
+        for j, p in enumerate(parts):
+            indices.append((i, j))
+            values.append(p)
+    return (np.asarray(indices, np.int64).reshape(-1, 2), values,
+            np.asarray([len(strings), max_c], np.int64))
+
+
+@op("select")
+def _select(cond, a, b):
+    """TF Select (ref: generic/parity_ops/select.cpp)."""
+    return jnp.where(jnp.asarray(cond, bool), a, b)
+
+
+@op("where_np")
+def _where_np(cond, a=None, b=None):
+    """numpy-flavoured where (ref: generic/parity_ops/where_np.cpp):
+    1-arg form returns the [N, rank] index matrix of true positions,
+    padded with -1 rows to the input size (static shapes under jit)."""
+    cond = jnp.asarray(cond)
+    if a is not None:
+        return jnp.where(cond.astype(bool), a, b)
+    flat = cond.reshape(-1).astype(bool)
+    n = flat.shape[0]
+    order = jnp.argsort(~flat)  # true positions first, stable
+    rows = jnp.stack(jnp.unravel_index(order, cond.shape), axis=1)
+    valid = flat[order][:, None]
+    return jnp.where(valid, rows, -1), jnp.sum(flat.astype(jnp.int32))
+
+
+@op("choose")
+def _choose(x, comp, mode=0):
+    """nd4j 'choose' (generic/parity_ops/choose.cpp): filter by comparison
+    mode (0:<, 1:<=, 2:>, 3:>=, 4:==, 5:!=) against scalar/array ``comp``.
+    Returns (matching values front-packed, count) with static shapes."""
+    x = jnp.asarray(x).reshape(-1)
+    cmp = [jnp.less, jnp.less_equal, jnp.greater, jnp.greater_equal,
+           jnp.equal, jnp.not_equal][mode]
+    keep = cmp(x, comp)
+    order = jnp.argsort(~keep)
+    vals = jnp.where(keep[order], x[order], 0)
+    return vals, jnp.sum(keep.astype(jnp.int32))
+
+
+@op("identity_n")
+def _identity_n(*xs):
+    """(ref: generic/parity_ops/identity_n.cpp)"""
+    return tuple(jnp.asarray(x) for x in xs)
+
+
+@op("crelu")
+def _crelu(x, axis=-1):
+    """Concatenated ReLU (ref: generic/parity_ops/crelu.cpp)."""
+    return jnp.concatenate([jax.nn.relu(x), jax.nn.relu(-x)], axis=axis)
+
+
+@op("precise_gelu")
+def _precise_gelu(x):
+    """erf-form gelu (ref: generic/nn/activations — precise_gelu)."""
+    return jax.nn.gelu(x, approximate=False)
+
+
+@op("argamax")
+def _argamax(x, axis=None):
+    """Index of max |x| (legacy IAMax / declarable argamax)."""
+    return jnp.argmax(jnp.abs(x), axis=axis)
+
+
+@op("argamin")
+def _argamin(x, axis=None):
+    return jnp.argmin(jnp.abs(x), axis=axis)
+
+
+@op("ones_as")
+def _ones_as(x):
+    return jnp.ones_like(x)
+
+
+@op("zeros_as")
+def _zeros_as(x):
+    return jnp.zeros_like(x)
+
+
+@op("assert")
+def _assert(cond, message="assertion failed"):
+    """Host assertion on concrete values; under jit it degrades to a
+    checkable passthrough (the reference's Assert is likewise a no-op in
+    release graphs)."""
+    c = jnp.asarray(cond)
+    if not isinstance(c, jax.core.Tracer) and not bool(jnp.all(c)):
+        raise AssertionError(message)
+    return c
+
+
+@op("fake_quant_with_min_max_vars_per_channel")
+def _fake_quant_per_channel(x, mins, maxs, num_bits=8, narrow_range=False):
+    """Per-channel variant (last axis) of fake_quant_with_min_max_vars."""
+    per = OPS["fake_quant_with_min_max_vars"]
+    return jax.vmap(lambda col, lo, hi: per(col, lo, hi, num_bits, narrow_range),
+                    in_axes=(-1, 0, 0), out_axes=-1)(x, mins, maxs)
+
+
+@op("match_condition")
+def _match_condition(x, value, mode=4, eps=1e-5):
+    """Count of elements matching a condition (legacy MatchCondition
+    reduction; mode as in 'choose', 4 = eps-equals)."""
+    x = jnp.asarray(x)
+    if mode == 4:
+        keep = jnp.abs(x - value) <= eps
+    else:
+        keep = [jnp.less, jnp.less_equal, jnp.greater, jnp.greater_equal,
+                None, jnp.not_equal][mode](x, value)
+    return jnp.sum(keep.astype(jnp.int64))
+
+
+@op("evaluate_reduction_shape")
+def _evaluate_reduction_shape(shape, axes, keepdims=False):
+    """(ref: generic/shape/evaluate_reduction_shape.cpp)"""
+    shape = [int(s) for s in np.asarray(shape).reshape(-1)]
+    axes = {a % len(shape) for a in np.asarray(axes).reshape(-1).tolist()}
+    if keepdims:
+        out = [1 if i in axes else d for i, d in enumerate(shape)]
+    else:
+        out = [d for i, d in enumerate(shape) if i not in axes]
+    return np.asarray(out, np.int64)
+
+
+@op("create")
+def _create(shape, dtype="float32", order="c"):
+    """Allocate a zeroed array (ref: generic/parity_ops/create.cpp); order
+    is metadata here — XLA owns physical layout (SURVEY §2.9)."""
+    return jnp.zeros(tuple(int(s) for s in np.asarray(shape).reshape(-1)),
+                     jnp.dtype(dtype))
+
+
+@op("broadcastgradientargs")
+def _broadcastgradientargs(shape_a, shape_b):
+    """Axes each operand must sum-reduce over after a broadcast op — the
+    TF BroadcastGradientArgs contract (ref: generic/shape/
+    broadcastgradientargs? — used by the import path's grad splitting)."""
+    sa = [int(s) for s in np.asarray(shape_a).reshape(-1)]
+    sb = [int(s) for s in np.asarray(shape_b).reshape(-1)]
+    r = max(len(sa), len(sb))
+    pa = [1] * (r - len(sa)) + sa
+    pb = [1] * (r - len(sb)) + sb
+    ra = [i for i in range(r) if pa[i] == 1 and pb[i] != 1]
+    rb = [i for i in range(r) if pb[i] == 1 and pa[i] != 1]
+    return np.asarray(ra, np.int64), np.asarray(rb, np.int64)
+
+
+@op("tear")
+def _tear(x, axis=0):
+    """Split into unit slices along axis (ref: generic/transforms/tear.cpp);
+    returns a tuple, the inverse of stack."""
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(jnp.asarray(x), x.shape[axis], axis=axis))
+
+
+@op("truncatemod")
+def _truncatemod(a, b):
+    """C-style remainder, truncation toward zero (generic/broadcastable)."""
+    return jnp.fmod(a, b)
+
+
+@op("axpy")
+def _axpy(x, y, alpha=1.0):
+    """BLAS axpy as a declarable op (legacy blas/axpy)."""
+    return alpha * x + y
+
+
+@op("stabilize")
+def _stabilize(x, cutoff=1e-5):
+    """Legacy Stabilize transform: clamp tiny magnitudes away from zero
+    (negatives to −cutoff, zero and small positives to +cutoff)."""
+    return jnp.where(jnp.abs(x) < cutoff,
+                     jnp.where(x < 0, -cutoff, cutoff), x)
+
+
+@op("log_x")
+def _log_x(x, base=np.e):
+    """Legacy LogX transform: log base-n."""
+    return jnp.log(x) / np.log(base)
+
+
+@op("pow_derivative")
+def _pow_derivative(x, p=2.0):
+    """Legacy PowDerivative transform: p * x^(p-1)."""
+    return p * x ** (p - 1.0)
+
+
+# -------------------------------------------------------------- linalg tail
+
+
+@op("eig")
+def _eig(x):
+    """General (non-symmetric) eigendecomposition. Host-side numpy: XLA has
+    no TPU lowering for general eig (the reference's is a CPU helper too;
+    ref: generic/linalg — eig). Returns (eigenvalues, eigenvectors),
+    complex64."""
+    w, v = np.linalg.eig(np.asarray(x, np.float64))
+    return np.asarray(w, np.complex64), np.asarray(v, np.complex64)
+
+
+@op("logdet")
+def _logdet(x):
+    """log|det| for SPD batches via Cholesky (ref: generic/linalg/logdet.cpp)."""
+    L = jnp.linalg.cholesky(x)
+    return 2.0 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+
+
+@op("solve_ls")
+def _solve_ls(a, b, fast=True):
+    """Least-squares solve, nd4j spelling (generic/linalg/lstsq.cpp twin)."""
+    return OPS["lstsq"](a, b)
+
+
+# ------------------------------------------------------------ updater family
+# (ref: libnd4j/include/ops/declarable/generic/updaters/*.cpp — the raw
+# updater math as declarable ops, distinct from the nn/updaters.py classes
+# the trainers use; both exist upstream.)
+
+
+@op("apply_sgd")
+def _apply_sgd(params, grad, lr=0.01):
+    """(ref: generic/nn/apply_sgd.cpp)"""
+    return params - lr * grad
+
+
+@op("sgd_updater")
+def _sgd_updater(grad, lr=0.01):
+    return grad * lr
+
+
+@op("nesterovs_updater")
+def _nesterovs_updater(grad, state_v, lr=0.1, momentum=0.9):
+    """DL4J Nesterov momentum (ref: generic/updaters/nesterovsUpdater.cpp):
+    v ← μv − λg; update = μ·v_prev − (1+μ)·v (applied as params − update)."""
+    v = momentum * state_v - lr * grad
+    return momentum * state_v - (1.0 + momentum) * v, v
+
+
+@op("adam_updater")
+def _adam_updater(grad, state_u, state_m, lr=1e-3, beta1=0.9, beta2=0.999,
+                  eps=1e-8, iteration=0):
+    m = beta1 * state_m + (1 - beta1) * grad
+    u = beta2 * state_u + (1 - beta2) * grad * grad
+    t = iteration + 1
+    a = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    return a * m / (jnp.sqrt(u) + eps), u, m
+
+
+@op("ada_grad_updater")
+def _ada_grad_updater(grad, state_h, lr=0.01, eps=1e-6):
+    h = state_h + grad * grad
+    return lr * grad / (jnp.sqrt(h) + eps), h
+
+
+@op("ada_delta_updater")
+def _ada_delta_updater(grad, state_msg, state_msdx, rho=0.95, eps=1e-6):
+    msg = rho * state_msg + (1 - rho) * grad * grad
+    dx = jnp.sqrt(state_msdx + eps) / jnp.sqrt(msg + eps) * grad
+    msdx = rho * state_msdx + (1 - rho) * dx * dx
+    return dx, msg, msdx
+
+
+@op("rms_prop_updater")
+def _rms_prop_updater(grad, state_g, lr=0.01, decay=0.95, eps=1e-8):
+    g = decay * state_g + (1 - decay) * grad * grad
+    return lr * grad / (jnp.sqrt(g) + eps), g
+
+
+@op("ada_max_updater")
+def _ada_max_updater(grad, state_u, state_m, lr=2e-3, beta1=0.9, beta2=0.999,
+                     eps=1e-8, iteration=0):
+    m = beta1 * state_m + (1 - beta1) * grad
+    u = jnp.maximum(beta2 * state_u, jnp.abs(grad))
+    t = iteration + 1
+    return lr / (1 - beta1 ** t) * m / (u + eps), u, m
+
+
+@op("nadam_updater")
+def _nadam_updater(grad, state_u, state_m, lr=1e-3, beta1=0.9, beta2=0.999,
+                   eps=1e-8, iteration=0):
+    m = beta1 * state_m + (1 - beta1) * grad
+    u = beta2 * state_u + (1 - beta2) * grad * grad
+    t = iteration + 1
+    mhat = m / (1 - beta1 ** t)
+    uhat = u / (1 - beta2 ** t)
+    return lr * (beta1 * mhat + (1 - beta1) * grad / (1 - beta1 ** t)) / (
+        jnp.sqrt(uhat) + eps), u, m
+
+
+@op("ams_grad_updater")
+def _ams_grad_updater(grad, state_u, state_m, state_h, lr=1e-3, beta1=0.9,
+                      beta2=0.999, eps=1e-8, iteration=0):
+    m = beta1 * state_m + (1 - beta1) * grad
+    u = beta2 * state_u + (1 - beta2) * grad * grad
+    h = jnp.maximum(state_h, u)
+    t = iteration + 1
+    a = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    return a * m / (jnp.sqrt(h) + eps), u, m, h
+
+
+@op("adabelief_updater")
+def _adabelief_updater(grad, state_u, state_m, lr=1e-3, beta1=0.9, beta2=0.999,
+                       eps=1e-8, iteration=0):
+    m = beta1 * state_m + (1 - beta1) * grad
+    u = beta2 * state_u + (1 - beta2) * (grad - m) ** 2 + eps
+    t = iteration + 1
+    a = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    return a * m / (jnp.sqrt(u) + eps), u, m
+
+
+# -------------------------------------------------------- NDArrayList family
+# (ref: generic/list/*.cpp — the graph-side TensorArray/NDArrayList ops.
+# The container is host-side by design, like the reference's CPU list
+# holder; the arrays inside stay on device.)
+
+
+class NDArrayList:
+    """Append/scatter list of same-rank arrays (ref: nd4j NDArrayList)."""
+
+    def __init__(self, arrays=None):
+        self.arrays = dict(arrays or {})
+
+    def max_index(self):
+        return max(self.arrays, default=-1)
+
+
+@op("create_list")
+def _create_list(*_unused):
+    return NDArrayList()
+
+
+@op("write_list")
+def _write_list(lst, idx, arr):
+    lst.arrays[int(idx)] = jnp.asarray(arr)
+    return lst
+
+
+@op("read_list")
+def _read_list(lst, idx):
+    return lst.arrays[int(idx)]
+
+
+@op("size_list")
+def _size_list(lst):
+    return np.int64(lst.max_index() + 1)
+
+
+@op("stack_list")
+def _stack_list(lst):
+    return jnp.stack([lst.arrays[i] for i in range(lst.max_index() + 1)])
+
+
+@op("unstack_list")
+def _unstack_list(arr):
+    arr = jnp.asarray(arr)
+    return NDArrayList({i: arr[i] for i in range(arr.shape[0])})
+
+
+@op("scatter_list")
+def _scatter_list(lst, indices, arr):
+    arr = jnp.asarray(arr)
+    for j, i in enumerate(np.asarray(indices).reshape(-1)):
+        lst.arrays[int(i)] = arr[j]
+    return lst
+
+
+@op("gather_list")
+def _gather_list(lst, indices):
+    return jnp.stack([lst.arrays[int(i)] for i in np.asarray(indices).reshape(-1)])
+
+
+@op("split_list")
+def _split_list(lst, arr, sizes):
+    arr = jnp.asarray(arr)
+    off = 0
+    for i, s in enumerate(np.asarray(sizes).reshape(-1)):
+        lst.arrays[i] = arr[off:off + int(s)]
+        off += int(s)
+    return lst
+
+
+@op("pick_list")
+def _pick_list(lst, indices):
+    return jnp.concatenate([jnp.atleast_1d(lst.arrays[int(i)])
+                            for i in np.asarray(indices).reshape(-1)])
+
+
+@op("clone_list")
+def _clone_list(lst):
+    return NDArrayList(lst.arrays)
+
+
+@op("delete_list")
+def _delete_list(lst, idx=None):
+    if idx is None:
+        lst.arrays.clear()
+    else:
+        lst.arrays.pop(int(idx), None)
+    return lst
+
+
+# -------------------------------------------------- Barnes-Hut tSNE helpers
+
+
+@op("barnes_gains")
+def _barnes_gains(gains, gradx, epsilon):
+    """tSNE adaptive gains (ref: generic — barnes_gains; helpers/
+    BarnesHutTsne): +0.2 where grad and step disagree in sign, ×0.8 where
+    they agree, floored at 0.01."""
+    same = jnp.sign(gradx) == jnp.sign(epsilon)
+    return jnp.maximum(jnp.where(same, gains * 0.8, gains + 0.2), 0.01)
+
+
+@op("barnes_edge_forces")
+def _barnes_edge_forces(row_p, col_p, val_p, n, data):
+    """Attractive edge forces over the sparse P (CSR rows row_p, cols
+    col_p, values val_p): F_i = Σ_j p_ij (y_i - y_j) / (1 + |y_i - y_j|²).
+    Edge loop is a segment-sum — TPU-friendly, no scatter races."""
+    row_p = np.asarray(row_p, np.int64).reshape(-1)
+    col = jnp.asarray(col_p, jnp.int32).reshape(-1)
+    val = jnp.asarray(val_p)
+    data = jnp.asarray(data)
+    src = np.repeat(np.arange(n), np.diff(row_p)).astype(np.int32)
+    d = data[src] - data[col]
+    w = val / (1.0 + jnp.sum(d * d, axis=-1))
+    return jax.ops.segment_sum(w[:, None] * d, jnp.asarray(src), num_segments=int(n))
+
+
+@op("barnes_symmetrized")
+def _barnes_symmetrized(row_p, col_p, val_p, n):
+    """Symmetrize sparse P: P = (P + Pᵀ)/2 on CSR triplets. Host-side —
+    output sparsity is data-dependent (the reference's is a CPU helper)."""
+    row_p = np.asarray(row_p, np.int64).reshape(-1)
+    col_p = np.asarray(col_p, np.int64).reshape(-1)
+    val_p = np.asarray(val_p, np.float64).reshape(-1)
+    acc = {}
+    for i in range(int(n)):
+        for k in range(row_p[i], row_p[i + 1]):
+            j = int(col_p[k])
+            acc[(i, j)] = acc.get((i, j), 0.0) + val_p[k] / 2.0
+            acc[(j, i)] = acc.get((j, i), 0.0) + val_p[k] / 2.0
+    keys = sorted(acc)
+    rows = np.zeros(int(n) + 1, np.int64)
+    for (i, _j) in keys:
+        rows[i + 1] += 1
+    rows = np.cumsum(rows)
+    cols = np.asarray([j for (_i, j) in keys], np.int64)
+    vals = np.asarray([acc[k] for k in keys], np.float32)
+    return rows, cols, vals
+
+
+@op("cell_contains")
+def _cell_contains(corner, width, point):
+    """Barnes-Hut space-partitioning predicate: point inside the cell
+    [corner - width/2, corner + width/2] on every axis."""
+    corner = jnp.asarray(corner)
+    width = jnp.asarray(width)
+    point = jnp.asarray(point)
+    return jnp.all((point >= corner - width / 2) & (point <= corner + width / 2))
+
+
+@op("knn_mindistance")
+def _knn_mindistance(point, lowest, highest):
+    """Min distance from a point to an axis-aligned box (ref: generic/
+    parity_ops/knn_mindistance.cpp — the KNN tree-pruning bound)."""
+    clamped = jnp.clip(jnp.asarray(point), lowest, highest)
+    return jnp.sqrt(jnp.sum((point - clamped) ** 2))
+
+
+# ------------------------------------------------- compression codec ops
+# (ref: generic/compression/threshold.cpp + bitmap.cpp; same semantics as
+# the C++ codecs in native/tnd.cpp — these are the graph-op spellings.)
+
+
+@op("encode_threshold")
+def _encode_threshold(grad, threshold=1e-3):
+    """Sign-threshold encode: returns (flat indices int32, signs ±1 float32,
+    residual). Elements |g| >= threshold are quantized to ±threshold and
+    subtracted; the rest accumulate in the residual."""
+    g = jnp.asarray(grad)
+    flat = g.reshape(-1)
+    fire = jnp.abs(flat) >= threshold
+    order = jnp.argsort(~fire)
+    idx = jnp.where(fire[order], order, -1).astype(jnp.int32)
+    signs = jnp.where(fire[order], jnp.sign(flat[order]), 0.0)
+    residual = jnp.where(fire, flat - jnp.sign(flat) * threshold, flat).reshape(g.shape)
+    return idx, signs, residual
+
+
+@op("decode_threshold")
+def _decode_threshold(idx, signs, shape, threshold=1e-3):
+    flat = jnp.zeros(int(np.prod(shape)), jnp.float32)
+    safe = jnp.where(idx >= 0, idx, 0)
+    flat = flat.at[safe].add(jnp.where(idx >= 0, signs * threshold, 0.0))
+    return flat.reshape(tuple(int(s) for s in np.asarray(shape).reshape(-1)))
+
+
+@op("encode_bitmap")
+def _encode_bitmap(grad, threshold=1e-3):
+    """2-bit bitmap encode (ref: bitmap.cpp): 0 = skip, 1 = +threshold,
+    2 = -threshold, packed 16 codes per int32. Returns (codes, residual)."""
+    g = jnp.asarray(grad).reshape(-1)
+    code = jnp.where(g >= threshold, 1, jnp.where(g <= -threshold, 2, 0)).astype(jnp.uint32)
+    pad = (-code.shape[0]) % 16
+    code = jnp.pad(code, (0, pad))
+    packed = code.reshape(-1, 16) << (2 * jnp.arange(16, dtype=jnp.uint32))
+    codes = lax.reduce(packed, jnp.uint32(0), lax.bitwise_or, (1,))
+    applied = jnp.where(code[:g.shape[0]] == 1, threshold,
+                        jnp.where(code[:g.shape[0]] == 2, -threshold, 0.0))
+    return codes.astype(jnp.int32), (g - applied).reshape(jnp.asarray(grad).shape)
+
+
+@op("decode_bitmap")
+def _decode_bitmap(codes, length, threshold=1e-3):
+    c = jnp.asarray(codes).astype(jnp.uint32)
+    expanded = (c[:, None] >> (2 * jnp.arange(16, dtype=jnp.uint32))) & 0x3
+    flat = expanded.reshape(-1)[:int(length)]
+    return jnp.where(flat == 1, threshold, jnp.where(flat == 2, -threshold, 0.0))
+
+
+# ------------------------------------------------------------- reduce tail
+# (the declarable reduce_* spellings — distinct registry entries from the
+# legacy norm1/norm2/normmax/sqnorm reductions upstream, same math)
+
+
+@op("reduce_norm1")
+def _reduce_norm1(x, dims=None, keepdims=False):
+    return jnp.sum(jnp.abs(x), axis=dims, keepdims=keepdims)
+
+
+@op("reduce_norm2")
+def _reduce_norm2(x, dims=None, keepdims=False):
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=dims, keepdims=keepdims))
+
+
+@op("reduce_norm_max")
+def _reduce_norm_max(x, dims=None, keepdims=False):
+    return jnp.max(jnp.abs(x), axis=dims, keepdims=keepdims)
+
+
+@op("reduce_sqnorm")
+def _reduce_sqnorm(x, dims=None, keepdims=False):
+    return jnp.sum(jnp.square(x), axis=dims, keepdims=keepdims)
+
+
+@op("reduce_variance")
+def _reduce_variance(x, dims=None, keepdims=False, bias_corrected=False):
+    return jnp.var(x, axis=dims, keepdims=keepdims,
+                   ddof=1 if bias_corrected else 0)
+
+
+@op("reduce_stdev")
+def _reduce_stdev(x, dims=None, keepdims=False, bias_corrected=False):
+    return jnp.std(x, axis=dims, keepdims=keepdims,
+                   ddof=1 if bias_corrected else 0)
+
+
+# -------------------------------------------------------------- shape tail
+
+
+@op("order")
+def _order(x, order="c"):
+    """Layout-order copy (ref: generic/shape/order.cpp). Physical layout is
+    XLA's (SURVEY §2.9) — semantically a copy; the NDArray facade carries
+    the order flag."""
+    return jnp.asarray(x) + 0
+
+
+@op("tile_to_shape")
+def _tile_to_shape(x, shape):
+    """(ref: generic/shape/tile_to_shape.cpp)"""
+    shape = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+    reps = tuple(t // s for t, s in zip(shape, x.shape))
+    return jnp.tile(x, reps)
+
+
+@op("reshape_as")
+def _reshape_as(x, y):
+    return jnp.reshape(x, jnp.asarray(y).shape)
+
+
+@op("flatten")
+def _flatten(*xs, order="c"):
+    """Concat of raveled inputs (ref: generic/flatten.cpp)."""
+    return jnp.concatenate([jnp.asarray(x).reshape(-1) for x in xs])
+
+
+@op("shapes_of")
+def _shapes_of(*xs):
+    return tuple(np.asarray(jnp.asarray(x).shape, np.int64) for x in xs)
+
+
+# ---------------------------------------------------------------- nlp tail
+
+
+@op("skipgram_inference")
+def _skipgram_inference(syn0, syn1neg, center, targets):
+    """Inference-mode skip-gram scores (newer sg_cb.cpp *_inference ops):
+    sigmoid(h · w_t) for one center row against target rows — no update."""
+    h = jnp.asarray(syn0)[jnp.asarray(center, jnp.int32)]
+    w = jnp.asarray(syn1neg)[jnp.asarray(targets, jnp.int32)]
+    return jax.nn.sigmoid(w @ h)
+
+
+@op("cbow_inference")
+def _cbow_inference(syn0, syn1neg, context, targets):
+    """Inference-mode CBOW scores: h = mean of context rows."""
+    h = jnp.asarray(syn0)[jnp.asarray(context, jnp.int32)].mean(axis=0)
+    w = jnp.asarray(syn1neg)[jnp.asarray(targets, jnp.int32)]
+    return jax.nn.sigmoid(w @ h)
+
+
+# ----------------------------------------------------------- attention tail
+
+
+@op("dot_product_attention_v2")
+def _dot_product_attention_v2(q, k, v, mask=None, scale=None, causal=False):
+    """The newer libnd4j attention op (generic/nn/dot_product_attention_v2
+    .cpp) — routed through the framework front door, so on TPU it hits the
+    Pallas flash path incl. the masked variant ([B,H,T,D] layout)."""
+    from ..kernels.attention import dot_product_attention
+
+    return dot_product_attention(q, k, v, mask, causal=causal, scale=scale)
+
+
+# ----------------------------------------------------------------- util ops
+
+
+@op("print_variable")
+def _print_variable(x, message=""):
+    jax.debug.print("{m}{x}", m=message, x=x)
+    return x
+
+
+@op("print_affinity")
+def _print_affinity(x):
+    x = jnp.asarray(x)
+    dev = getattr(x, "devices", lambda: {"<traced>"})()
+    jax.debug.print("affinity: {d}", d=str(dev))
+    return x
+
+
+# --------------------------------------- reference-canonical name aliases
+# The libnd4j registry spells several ops differently from the TF-flavoured
+# names earlier waves registered; both spellings are real probe-able
+# vocabulary upstream, so both resolve here (same impl object). The test
+# gate imports this map so alias and validation case stay in lockstep.
+
+CANONICAL_ALIASES = {
+    "avgpool2d": "avg_pool2d",
+    "maxpool2d": "max_pool2d",
+    "avgpool3dnew": "avg_pool3d",
+    "maxpool3dnew": "max_pool3d",
+    "conv3dnew": "conv3d",
+    "batchnorm": "batch_norm",
+    "softmax_cross_entropy_loss": "softmax_cross_entropy",
+    "sigm_cross_entropy_loss": "sigmoid_cross_entropy",
+    "absolute_difference_loss": "absolute_difference",
+    "cosine_distance_loss": "cosine_distance",
+    "mean_sqerr_loss": "mean_squared_error",
+}
+for _canon, _alias in CANONICAL_ALIASES.items():
+    OPS[_canon] = OPS[_alias]

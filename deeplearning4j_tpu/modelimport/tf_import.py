@@ -205,18 +205,25 @@ def _shape(ctx, ins, attrs, name):
     return np.asarray(np.shape(x), np.int64)
 
 
+def _known_shape(x, opname):
+    """Shape with the same shapeless-placeholder guidance Shape gives
+    (ADVICE r4: a None shape must raise TFImportError, not TypeError)."""
+    shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
+    if shape is None or (isinstance(x, SDVariable) and None in shape):
+        raise TFImportError(
+            f"{opname} of dynamically-shaped tensor "
+            f"{getattr(x, 'name', '?')} (re-freeze with static shapes)")
+    return shape
+
+
 @_m("Size")
 def _size(ctx, ins, attrs, name):
-    x = ins[0]
-    shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
-    return np.asarray(int(np.prod(shape)), np.int64)
+    return np.asarray(int(np.prod(_known_shape(ins[0], "Size"))), np.int64)
 
 
 @_m("Rank")
 def _rank(ctx, ins, attrs, name):
-    x = ins[0]
-    shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
-    return np.asarray(len(shape), np.int64)
+    return np.asarray(len(_known_shape(ins[0], "Rank")), np.int64)
 
 
 @_m("Fill")
@@ -319,7 +326,10 @@ def _slice(ctx, ins, attrs, name):
     begin = tuple(int(b) for b in ctx.static(ins[1], "Slice begin"))
     raw_size = ctx.static(ins[2], "Slice size")
     x = ins[0]
-    shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
+    if any(int(sz) == -1 for sz in raw_size):
+        shape = _known_shape(x, "Slice")  # -1 expansion needs a static shape
+    else:
+        shape = x.shape if isinstance(x, SDVariable) else np.shape(x)
     size = tuple(shape[d] - begin[d] if int(sz) == -1 else int(sz)
                  for d, sz in enumerate(raw_size))
     return ctx.apply("slice", x, begin=begin, size=size, name=name)

@@ -587,7 +587,7 @@ def _np_cbow(syn0, syn1, ctxw, target, negs, lr):
         for t, lab in zip([target[bi]] + list(negs[bi]), [1.0] + [0.0] * negs.shape[1]):
             g = (1 / (1 + np.exp(-h @ syn1[t])) - lab) * lr
             for cw in ctxw[bi]:
-                d0[cw] -= g * syn1[t] / W
+                d0[cw] -= g * syn1[t]  # undivided neu1e, word2vec.c semantics
             d1[t] -= g * h
     return syn0 + d0, syn1 + d1
 
@@ -991,6 +991,413 @@ CASES.update({
                                   float(out), _np_pairwssqerr(A, B),
                                   rtol=1e-5), (1,)),
 })
+
+
+# ------------------------------------------------------------------ wave 4
+# (deeplearning4j_tpu/autodiff/ops_wave4.py — VERDICT r4 missing #1 tail)
+
+import math as _math
+
+from deeplearning4j_tpu.autodiff.ops_wave4 import NDArrayList
+
+X1D = IMG[:, :, :, 0].copy()                       # [2,3,6] NCW
+NHWC4 = np.transpose(IMG, (0, 2, 3, 1)).copy()      # [2,6,6,3]
+PW1 = (R.randn(4, 3, 1, 1) * 0.3).astype(np.float32)
+
+
+def _np_rnn(x, h0, wx, wh, b):
+    h = h0.copy()
+    ys = []
+    for t in range(x.shape[0]):
+        h = np.tanh(x[t] @ wx + h @ wh + b)
+        ys.append(h.copy())
+    return np.stack(ys), h
+
+
+_RNN_ARGS = (R.randn(4, 2, 3).astype(np.float32), np.zeros((2, 5), np.float32),
+             (R.randn(3, 5) * 0.4).astype(np.float32),
+             (R.randn(5, 5) * 0.4).astype(np.float32), np.zeros(5, np.float32))
+_RNN_B = tuple(np.asarray(a).copy() for a in
+               ((R.randn(3, 5) * 0.4), (R.randn(5, 5) * 0.4), np.zeros(5)))
+_RNN_B = tuple(a.astype(np.float32) for a in _RNN_B)
+_SRU_W2 = tuple((R.randn(*np.asarray(w).shape) * 0.4).astype(np.float32)
+                if np.asarray(w).ndim > 1 else np.zeros_like(np.asarray(w))
+                for w in _SRU_ARGS[2:])
+
+
+def _np_bi_rnn(x, h0f, h0b, wxf, whf, bf, wxb, whb, bb):
+    yf, hf = _np_rnn(x, h0f, wxf, whf, bf)
+    yb, hb = _np_rnn(x[::-1], h0b, wxb, whb, bb)
+    return np.concatenate([yf, yb[::-1]], -1), hf, hb
+
+
+def _np_adamlike(g, u, m, lr, b1, b2, eps, t):
+    m2 = b1 * m + (1 - b1) * g
+    u2 = b2 * u + (1 - b2) * g * g
+    a = lr * np.sqrt(1 - b2 ** (t + 1)) / (1 - b1 ** (t + 1))
+    return a * m2 / (np.sqrt(u2) + eps), u2, m2
+
+
+_Z = np.zeros_like(A)
+_BOXES = np.array([[[0.0, 0.0, 0.5, 0.5]]], np.float32)  # one box, B=1
+
+CASES.update({
+    # ------------------------------------------------------ conv/pool tail
+    "deconv3d": ((IMG5, K3), {},
+                 lambda out, args: np.asarray(out).shape == (1, 2, 8, 8, 8), (0, 1)),
+    "sconv2d": ((IMG, KDW, PW1), {},
+                lambda out, args: np.testing.assert_allclose(
+                    np.asarray(out),
+                    np.asarray(OPS["separable_conv2d"](IMG, KDW, PW1)),
+                    rtol=1e-4, atol=1e-5), (0, 1)),
+    "pointwise_conv2d": ((IMG, PW1), {},
+                         np.einsum("nchw,ocij->nohw", IMG, PW1), (0, 1)),
+    "deconv2d_tf": (((2, 2, 12, 12), KTR, IMG), {},
+                    lambda out, args: np.testing.assert_allclose(
+                        np.asarray(out), np.asarray(OPS["deconv2d"](IMG, KTR)),
+                        rtol=1e-4, atol=1e-5), ()),
+    "max_pool1d": ((X1D,), {}, X1D.reshape(2, 3, 3, 2).max(-1), (0,)),
+    "maxpool1d": ((X1D,), {}, X1D.reshape(2, 3, 3, 2).max(-1), (0,)),
+    "avg_pool1d": ((X1D,), {}, X1D.reshape(2, 3, 3, 2).mean(-1), (0,)),
+    "avgpool1d": ((X1D,), {}, X1D.reshape(2, 3, 3, 2).mean(-1), (0,)),
+    "upsampling1d": ((X1D, 2), {}, np.repeat(X1D, 2, 2), (0,)),
+    "pnormpool2d": ((IMG,), {},
+                    ((IMG ** 2).reshape(2, 3, 3, 2, 3, 2).sum((3, 5))) ** 0.5, (0,)),
+    "ismax": ((A,), dict(axis=1),
+              (A == A.max(1, keepdims=True)).astype(np.float32), ()),
+    # ------------------------------------------------------------ rnn tail
+    "static_rnn": (_RNN_ARGS, {},
+                   lambda out, args: np.testing.assert_allclose(
+                       np.asarray(out[0]), _np_rnn(*_RNN_ARGS)[0],
+                       rtol=1e-4, atol=1e-5), (2, 3)),
+    "dynamic_rnn": ((np.swapaxes(_RNN_ARGS[0], 0, 1).copy(),) + _RNN_ARGS[1:], {},
+                    lambda out, args: np.testing.assert_allclose(
+                        np.asarray(out[0]),
+                        np.swapaxes(_np_rnn(*_RNN_ARGS)[0], 0, 1),
+                        rtol=1e-4, atol=1e-5), ()),
+    "static_bidirectional_rnn": (
+        (_RNN_ARGS[0], _RNN_ARGS[1], _RNN_ARGS[1].copy()) + _RNN_ARGS[2:] + _RNN_B, {},
+        lambda out, args: np.testing.assert_allclose(
+            np.asarray(out[0]),
+            _np_bi_rnn(_RNN_ARGS[0], _RNN_ARGS[1], _RNN_ARGS[1],
+                       *(_RNN_ARGS[2:] + _RNN_B))[0],
+            rtol=1e-4, atol=1e-5), (3,)),
+    "dynamic_bidirectional_rnn": (
+        (np.swapaxes(_RNN_ARGS[0], 0, 1).copy(), _RNN_ARGS[1], _RNN_ARGS[1].copy())
+        + _RNN_ARGS[2:] + _RNN_B, {},
+        lambda out, args: np.testing.assert_allclose(
+            np.asarray(out[0]),
+            np.swapaxes(_np_bi_rnn(_RNN_ARGS[0], _RNN_ARGS[1], _RNN_ARGS[1],
+                                   *(_RNN_ARGS[2:] + _RNN_B))[0], 0, 1),
+            rtol=1e-4, atol=1e-5), ()),
+    "lstm_block_cell": ((_LSTM_ARGS[0][0],) + _LSTM_ARGS[1:] + _PEEP, {},
+                        lambda out, args: np.testing.assert_allclose(
+                            np.asarray(out[0]),
+                            _np_lstm_peep(*[np.asarray(a) for a in
+                                            (_LSTM_ARGS[0][:1],) + _LSTM_ARGS[1:]
+                                            + _PEEP])[0][0],
+                            rtol=1e-4, atol=1e-5), (3,)),
+    "sru_bi": ((_SRU_ARGS[0], _SRU_ARGS[1], _SRU_ARGS[1].copy())
+               + _SRU_ARGS[2:] + _SRU_W2, {},
+               lambda out, args: np.testing.assert_allclose(
+                   np.asarray(out[0]),
+                   np.concatenate([_np_sru(*_SRU_ARGS)[0],
+                                   _np_sru(_SRU_ARGS[0][::-1], _SRU_ARGS[1],
+                                           *_SRU_W2)[0][::-1]], -1),
+                   rtol=1e-4, atol=1e-5), (3,)),
+    # --------------------------------------------------------- random tail
+    "multinomial": ((jax.random.key(0), np.zeros((2, 3), np.float32), 50), {},
+                    lambda out, args: (np.asarray(out).shape == (2, 50)
+                                       and int(np.max(np.asarray(out))) <= 2), ()),
+    "alpha_dropout": ((jax.random.key(0), A), dict(rate=0.0), A, ()),
+    "dropout_inverted": ((jax.random.key(0), A), dict(rate=0.0), A, ()),
+    "get_seed": ((), {}, lambda out, args: int(out) >= 0, ()),
+    "set_seed": ((123,), {}, lambda out, args: int(out) == 123, ()),
+    # ---------------------------------------------------------- image tail
+    "image_resize": ((NHWC4, (12, 12)), dict(method="nearest"),
+                     np.repeat(np.repeat(NHWC4, 2, 1), 2, 2), ()),
+    "draw_bounding_boxes": ((np.zeros((1, 4, 4, 1), np.float32), _BOXES), {},
+                            lambda out, args: (
+                                np.asarray(out)[0, 0, 0, 0] == 1.0     # corner
+                                and np.asarray(out)[0, 1, 1, 0] == 0.0  # interior
+                                and np.asarray(out)[0, 3, 3, 0] == 0.0), ()),
+    "rgb_to_yiq": ((NHWC4,), {},
+                   NHWC4 @ np.array([[0.299, 0.587, 0.114],
+                                    [0.5959, -0.2746, -0.3213],
+                                    [0.2115, -0.5227, 0.3112]], np.float32).T, (0,)),
+    "yiq_to_rgb": ((NHWC4,), {},
+                   lambda out, args: np.testing.assert_allclose(
+                       np.asarray(OPS["rgb_to_yiq"](out)), NHWC4,
+                       rtol=1e-3, atol=1e-4), (0,)),
+    "rgb_to_yuv": ((NHWC4,), {},
+                   lambda out, args: np.testing.assert_allclose(
+                       np.asarray(OPS["yuv_to_rgb"](out)), NHWC4,
+                       rtol=1e-3, atol=1e-4), (0,)),
+    "yuv_to_rgb": ((NHWC4,), {},
+                   lambda out, args: np.testing.assert_allclose(
+                       np.asarray(OPS["rgb_to_yuv"](out)), NHWC4,
+                       rtol=1e-3, atol=1e-4), (0,)),
+    "adjust_contrast_v2": ((NHWC4, 2.0), {},
+                           (NHWC4 - NHWC4.mean((1, 2), keepdims=True)) * 2.0
+                           + NHWC4.mean((1, 2), keepdims=True), (0,)),
+    "non_max_suppression_overlaps": (
+        (np.array([[1.0, 0.9, 0.0], [0.9, 1.0, 0.0], [0.0, 0.0, 1.0]], np.float32),
+         np.array([0.9, 0.8, 0.7], np.float32), 3), {},
+        lambda out, args: (np.asarray(out[0])[:2].tolist() == [0, 2]
+                           and int(out[1]) == 2), ()),
+    # ------------------------------------------------------------- bit ops
+    "toggle_bits": ((np.array([0, 1, -1], np.int32),), {},
+                    np.invert(np.array([0, 1, -1], np.int32)), ()),
+    "shift_bits": ((np.array([1, 2, 4], np.int32), 2), {},
+                   np.array([4, 8, 16], np.int32), ()),
+    "rshift_bits": ((np.array([4, 8, 16], np.int32), 2), {},
+                    np.array([1, 2, 4], np.int32), ()),
+    "bits_hamming_distance": ((np.array([0b1010], np.int32),
+                               np.array([0b0110], np.int32)), {}, 2, ()),
+    "hashcode": ((np.array([1, 2, 3], np.int32),), {},
+                 lambda out, args: (int(out) == int(OPS["hashcode"](
+                     np.array([1, 2, 3], np.int32)))
+                     and int(out) != int(OPS["hashcode"](
+                         np.array([3, 2, 1], np.int32)))), ()),
+    # --------------------------------------------------------- compat tail
+    "compat_sparse_to_dense": ((np.array([[0, 1], [1, 0]], np.int64), (2, 2),
+                                np.array([5.0, 6.0], np.float32)), {},
+                               np.array([[0, 5], [6, 0]], np.float32), ()),
+    "compat_string_split": ((np.array(["a b", "c"]),), {},
+                            lambda out, args: (out[0].shape == (3, 2)
+                                               and out[1] == ["a", "b", "c"]
+                                               and out[2].tolist() == [2, 2]), ()),
+    "select": ((A > 0, A, B), {}, np.where(A > 0, A, B), ()),
+    "where_np": ((np.array([[1, 0], [0, 1]], np.float32),), {},
+                 lambda out, args: (int(out[1]) == 2
+                                    and np.asarray(out[0])[:2].tolist()
+                                    == [[0, 0], [1, 1]]), ()),
+    "choose": ((A, 0.0), dict(mode=2),
+               lambda out, args: int(out[1]) == int((A > 0).sum()), ()),
+    "identity_n": ((A, B), {},
+                   lambda out, args: (np.array_equal(np.asarray(out[0]), A)
+                                      and np.array_equal(np.asarray(out[1]), B)), ()),
+    "crelu": ((OFF0,), {},
+              np.concatenate([np.maximum(OFF0, 0), np.maximum(-OFF0, 0)], -1), (0,)),
+    "precise_gelu": ((A,), {},
+                     0.5 * A * (1 + np.vectorize(_math.erf)(A / np.sqrt(2))), (0,)),
+    "argamax": ((OFF0,), dict(axis=1), np.argmax(np.abs(OFF0), 1), ()),
+    "argamin": ((OFF0,), dict(axis=1), np.argmin(np.abs(OFF0), 1), ()),
+    "ones_as": ((A,), {}, np.ones_like(A), ()),
+    "zeros_as": ((A,), {}, np.zeros_like(A), ()),
+    "assert": ((np.array([True, True]),), {},
+               lambda out, args: bool(np.all(np.asarray(out))), ()),
+    "fake_quant_with_min_max_vars_per_channel": (
+        (A, np.full(4, -1.0, np.float32), np.full(4, 1.0, np.float32)), {},
+        lambda out, args: np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(OPS["fake_quant_with_min_max_vars"](A, -1.0, 1.0)),
+            rtol=1e-5, atol=1e-6), ()),
+    "match_condition": ((A, 0.0), dict(mode=2), int((A > 0).sum()), ()),
+    "evaluate_reduction_shape": (((2, 3, 4), (1,)), {}, np.array([2, 4]), ()),
+    "create": (((2, 3),), {}, np.zeros((2, 3), np.float32), ()),
+    "broadcastgradientargs": (((3, 1, 4), (2, 1, 1, 4)), {},
+                              lambda out, args: (out[0].tolist() == [0]
+                                                 and out[1].tolist() == [1]), ()),
+    "tear": ((A,), dict(axis=0),
+             lambda out, args: (len(out) == 3
+                                and np.array_equal(np.asarray(out[1]), A[1])), ()),
+    "truncatemod": ((A, POS), {}, np.fmod(A, POS), ()),
+    "axpy": ((A, B), dict(alpha=2.0), 2 * A + B, (0, 1)),
+    "stabilize": ((np.array([0.0, 1e-6, -1e-6, 0.5], np.float32),), {},
+                  np.array([1e-5, 1e-5, -1e-5, 0.5], np.float32), ()),
+    "log_x": ((POS,), dict(base=10.0), np.log10(POS), (0,)),
+    "pow_derivative": ((POS,), dict(p=3.0), 3 * POS ** 2, (0,)),
+    # --------------------------------------------------------- linalg tail
+    "eig": ((SQ,), {},
+            lambda out, args: np.testing.assert_allclose(
+                np.asarray(SQ, np.complex64) @ np.asarray(out[1]),
+                np.asarray(out[1]) * np.asarray(out[0])[None, :],
+                rtol=1e-3, atol=1e-4), ()),
+    "logdet": ((SPD[None],), {},
+               np.array([np.log(np.linalg.det(SPD.astype(np.float64)))],
+                        np.float32), (0,)),
+    "solve_ls": ((SPD, A[:3, :2].copy()), {},
+                 lambda out, args: np.testing.assert_allclose(
+                     np.asarray(out), np.linalg.lstsq(SPD, A[:3, :2], rcond=None)[0],
+                     rtol=1e-3, atol=1e-4), ()),
+    # ------------------------------------------------------ updater family
+    "apply_sgd": ((A, B), dict(lr=0.1), A - 0.1 * B, (0, 1)),
+    "sgd_updater": ((A,), dict(lr=0.1), 0.1 * A, (0,)),
+    "nesterovs_updater": ((A, B), dict(lr=0.1, momentum=0.9),
+                          lambda out, args: np.testing.assert_allclose(
+                              np.asarray(out[0]),
+                              0.9 * B - 1.9 * (0.9 * B - 0.1 * A),
+                              rtol=1e-5, atol=1e-6), ()),
+    "adam_updater": ((A, _Z, _Z), dict(iteration=0),
+                     lambda out, args: np.testing.assert_allclose(
+                         np.asarray(out[0]),
+                         _np_adamlike(A, _Z, _Z, 1e-3, 0.9, 0.999, 1e-8, 0)[0],
+                         rtol=1e-4, atol=1e-7), ()),
+    "ada_grad_updater": ((A, _Z), dict(lr=0.01),
+                         lambda out, args: np.testing.assert_allclose(
+                             np.asarray(out[0]),
+                             0.01 * A / (np.abs(A) + 1e-6), rtol=1e-4), ()),
+    "ada_delta_updater": ((A, _Z, _Z), dict(rho=0.95),
+                          lambda out, args: np.testing.assert_allclose(
+                              np.asarray(out[0]),
+                              np.sqrt(1e-6) / np.sqrt(0.05 * A * A + 1e-6) * A,
+                              rtol=1e-4), ()),
+    "rms_prop_updater": ((A, _Z), dict(lr=0.01, decay=0.95),
+                         lambda out, args: np.testing.assert_allclose(
+                             np.asarray(out[0]),
+                             0.01 * A / (np.sqrt(0.05 * A * A) + 1e-8),
+                             rtol=1e-4), ()),
+    "ada_max_updater": ((A, _Z, _Z), dict(iteration=0),
+                        lambda out, args: np.testing.assert_allclose(
+                            np.asarray(out[0]),
+                            2e-3 / 0.1 * (0.1 * A) / (np.abs(A) + 1e-8),
+                            rtol=1e-4), ()),
+    "nadam_updater": ((A, _Z, _Z), dict(iteration=0),
+                      lambda out, args: np.all(np.isfinite(np.asarray(out[0]))), ()),
+    "ams_grad_updater": ((A, _Z, _Z, _Z), dict(iteration=0),
+                         lambda out, args: np.testing.assert_allclose(
+                             np.asarray(out[0]),
+                             _np_adamlike(A, _Z, _Z, 1e-3, 0.9, 0.999, 1e-8, 0)[0],
+                             rtol=1e-4, atol=1e-7), ()),
+    "adabelief_updater": ((A, _Z, _Z), dict(iteration=0),
+                          lambda out, args: np.all(np.isfinite(np.asarray(out[0]))), ()),
+    # --------------------------------------------------- NDArrayList family
+    "create_list": ((), {}, lambda out, args: isinstance(out, NDArrayList), ()),
+    "write_list": ((NDArrayList(), 0, A), {},
+                   lambda out, args: np.array_equal(np.asarray(out.arrays[0]), A), ()),
+    "read_list": ((NDArrayList({0: A}), 0), {}, A, ()),
+    "size_list": ((NDArrayList({0: A, 1: B}),), {}, 2, ()),
+    "stack_list": ((NDArrayList({0: A[0], 1: A[1]}),), {}, np.stack([A[0], A[1]]), ()),
+    "unstack_list": ((A,), {},
+                     lambda out, args: np.array_equal(np.asarray(out.arrays[1]), A[1]), ()),
+    "scatter_list": ((NDArrayList(), np.array([1, 0]), np.stack([A[0], A[1]])), {},
+                     lambda out, args: np.array_equal(np.asarray(out.arrays[1]), A[0]), ()),
+    "gather_list": ((NDArrayList({0: A[0], 1: A[1], 2: A[2]}), np.array([2, 0])), {},
+                    np.stack([A[2], A[0]]), ()),
+    "split_list": ((NDArrayList(), A, np.array([1, 2])), {},
+                   lambda out, args: (np.array_equal(np.asarray(out.arrays[0]), A[:1])
+                                      and np.array_equal(np.asarray(out.arrays[1]),
+                                                         A[1:3])), ()),
+    "pick_list": ((NDArrayList({0: A[0], 1: A[1]}), np.array([1, 0, 0])), {},
+                  np.concatenate([A[1], A[0], A[0]]), ()),
+    "clone_list": ((NDArrayList({0: A}),), {},
+                   lambda out, args: (isinstance(out, NDArrayList)
+                                      and out is not args[0]
+                                      and np.array_equal(np.asarray(out.arrays[0]), A)), ()),
+    "delete_list": ((NDArrayList({0: A}), 0), {},
+                    lambda out, args: len(out.arrays) == 0, ()),
+    # --------------------------------------------- Barnes-Hut tSNE helpers
+    "barnes_gains": ((np.ones(3, np.float32), np.array([1.0, -1.0, 1.0], np.float32),
+                      np.array([1.0, 1.0, -1.0], np.float32)), {},
+                     np.array([0.8, 1.2, 1.2], np.float32), ()),
+    "barnes_edge_forces": ((np.array([0, 1, 2], np.int64), np.array([1, 0], np.int64),
+                            np.array([1.0, 1.0], np.float32), 2,
+                            np.array([[0.0, 0.0], [1.0, 1.0]], np.float32)), {},
+                           np.array([[-1 / 3, -1 / 3], [1 / 3, 1 / 3]], np.float32), ()),
+    "barnes_symmetrized": ((np.array([0, 1, 2], np.int64), np.array([1, 0], np.int64),
+                            np.array([1.0, 1.0], np.float32), 2), {},
+                           lambda out, args: (out[0].tolist() == [0, 1, 2]
+                                              and out[1].tolist() == [1, 0]
+                                              and np.allclose(out[2], [1.0, 1.0])), ()),
+    "cell_contains": ((np.zeros(2, np.float32), np.array([2.0, 2.0], np.float32),
+                       np.array([0.5, 0.5], np.float32)), {}, True, ()),
+    "knn_mindistance": ((np.array([0.0, 0.0], np.float32),
+                         np.array([1.0, 1.0], np.float32),
+                         np.array([2.0, 2.0], np.float32)), {},
+                        np.sqrt(np.float32(2.0)), ()),
+    # ---------------------------------------------- compression codec ops
+    "encode_threshold": ((np.array([0.002, -0.0005, -0.003, 0.0001], np.float32),),
+                         dict(threshold=1e-3),
+                         lambda out, args: np.testing.assert_allclose(
+                             np.asarray(OPS["decode_threshold"](
+                                 out[0], out[1], (4,), threshold=1e-3))
+                             + np.asarray(out[2]),
+                             np.array([0.002, -0.0005, -0.003, 0.0001]),
+                             rtol=1e-5, atol=1e-7), ()),
+    "decode_threshold": ((np.array([2, 0, -1, -1], np.int32),
+                          np.array([1.0, -1.0, 0.0, 0.0], np.float32), (4,)),
+                         dict(threshold=0.5),
+                         np.array([-0.5, 0.0, 0.5, 0.0], np.float32), ()),
+    "encode_bitmap": ((np.array([0.002, -0.0005, -0.003, 0.0001], np.float32),),
+                      dict(threshold=1e-3),
+                      lambda out, args: np.testing.assert_allclose(
+                          np.asarray(OPS["decode_bitmap"](out[0], 4, threshold=1e-3))
+                          + np.asarray(out[1]),
+                          np.array([0.002, -0.0005, -0.003, 0.0001]),
+                          rtol=1e-5, atol=1e-7), ()),
+    "decode_bitmap": ((np.array([0b1001], np.int32), 4), dict(threshold=0.5),
+                      np.array([0.5, -0.5, 0.0, 0.0], np.float32), ()),
+    # ----------------------------------------------------- reduce_* family
+    "reduce_norm1": ((OFF0,), dict(dims=1), np.abs(OFF0).sum(1), (0,)),
+    "reduce_norm2": ((OFF0,), dict(dims=1), np.sqrt((OFF0 ** 2).sum(1)), (0,)),
+    "reduce_norm_max": ((OFF0,), dict(dims=1), np.abs(OFF0).max(1), ()),
+    "reduce_sqnorm": ((A,), dict(dims=1), (A ** 2).sum(1), (0,)),
+    "reduce_variance": ((A,), dict(dims=1), A.var(1), (0,)),
+    "reduce_stdev": ((A,), dict(dims=1, bias_corrected=True), A.std(1, ddof=1), (0,)),
+    # ----------------------------------------------------------- shape tail
+    "order": ((A,), dict(order="f"), A, ()),
+    "tile_to_shape": ((A, (6, 8)), {}, np.tile(A, (2, 2)), (0,)),
+    "reshape_as": ((A, np.zeros((4, 3))), {}, A.reshape(4, 3), (0,)),
+    "flatten": ((A, B), {}, np.concatenate([A.ravel(), B.ravel()]), (0, 1)),
+    "shapes_of": ((A, IMG), {},
+                  lambda out, args: (out[0].tolist() == [3, 4]
+                                     and out[1].tolist() == [2, 3, 6, 6]), ()),
+    # ------------------------------------------------------------ nlp tail
+    "skipgram_inference": ((SYN0, SYN1, 1, np.array([2, 3], np.int32)), {},
+                           1 / (1 + np.exp(-(SYN1[[2, 3]] @ SYN0[1]))), ()),
+    "cbow_inference": ((SYN0, SYN1, np.array([0, 2], np.int32),
+                        np.array([2, 3], np.int32)), {},
+                       1 / (1 + np.exp(-(SYN1[[2, 3]] @ SYN0[[0, 2]].mean(0)))), ()),
+    # ------------------------------------------------------- attention tail
+    "dot_product_attention_v2": (_ATTN, {},
+                                 lambda out, args: np.testing.assert_allclose(
+                                     np.asarray(out),
+                                     np.asarray(OPS["dot_product_attention"](*_ATTN)),
+                                     rtol=1e-4, atol=1e-5), (0, 1, 2)),
+    # -------------------------------------------------------------- util ops
+    "print_variable": ((A,), {}, A, ()),
+    "print_affinity": ((A,), {}, A, ()),
+})
+
+# reference-canonical spellings share the impl AND the validation case
+from deeplearning4j_tpu.autodiff.ops_wave4 import CANONICAL_ALIASES
+
+for _canon, _alias in CANONICAL_ALIASES.items():
+    CASES[_canon] = CASES[_alias]
+
+
+def test_dynamic_rnn_zeroes_past_seq_len():
+    """TF dynamic_rnn contract: outputs past each row's sequence_length are
+    ZERO (not the frozen state); final state freezes at the last real step
+    (r5 review finding)."""
+    x, h0, wx, wh, b = _RNN_ARGS
+    seq_len = np.array([4, 2], np.int32)
+    ys, hT = OPS["static_rnn"](x, h0, wx, wh, b, seq_len=seq_len)
+    ys_full, _ = _np_rnn(x, h0, wx, wh, b)
+    np.testing.assert_allclose(np.asarray(ys[:, 0]), ys_full[:, 0],
+                               rtol=1e-4, atol=1e-5)          # full-length row
+    np.testing.assert_allclose(np.asarray(ys[:2, 1]), ys_full[:2, 1],
+                               rtol=1e-4, atol=1e-5)          # real steps
+    np.testing.assert_array_equal(np.asarray(ys[2:, 1]), 0.0)  # zero padding
+    np.testing.assert_allclose(np.asarray(hT[1]), ys_full[1, 1],
+                               rtol=1e-4, atol=1e-5)          # frozen state
+
+
+def test_bidirectional_rnn_reverses_by_seq_len():
+    """Backward direction must consume each row's REAL data first
+    (reverse_sequence semantics), not the padding (r5 review finding)."""
+    x, h0, wx, wh, b = _RNN_ARGS
+    seq_len = np.array([4, 2], np.int32)
+    out, _, _ = OPS["static_bidirectional_rnn"](
+        x, h0, h0.copy(), wx, wh, b, *_RNN_B, seq_len=seq_len)
+    H = h0.shape[-1]
+    # row 1 has length 2: backward half over its real frames x[1], x[0]
+    yb_row1 = _np_rnn(x[:2, 1:2][::-1], h0[1:2], *_RNN_B)[0]
+    np.testing.assert_allclose(np.asarray(out)[1, 1, H:], yb_row1[0, 0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out)[0, 1, H:], yb_row1[1, 0],
+                               rtol=1e-4, atol=1e-5)
 
 
 @pytest.mark.parametrize("name", sorted(OPS))
