@@ -1,0 +1,235 @@
+"""NDArrayIndex / BooleanIndexing / Conditions — the nd4j indexing DSL.
+
+Reference: ``org.nd4j.linalg.indexing`` (SURVEY §2.2 J1; VERDICT r4 missing
+#2): ``NDArrayIndex.{all,point,interval,indices,newAxis}`` compose into
+``INDArray.get/put``; ``Conditions.*`` build predicate objects consumed by
+``BooleanIndexing.{replaceWhere,applyWhere,and,or,firstIndex,lastIndex}``
+and by ``INDArray.{cond,replaceWhere,getWhere,assignIf}``.
+
+TPU mapping: index objects lower to python basic/advanced indices on the
+NDArray facade — basic combinations (all/point/interval) produce aliasing
+VIEWS with write-through, advanced ones (indices) copy, exactly the
+reference's view-vs-copy split. Conditions are jnp-traceable callables, so
+every predicate fuses into XLA like any other elementwise op.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["NDArrayIndex", "Conditions", "Condition", "BooleanIndexing"]
+
+
+class NDArrayIndex:
+    """One index object for a single dimension (factory methods below).
+
+    ``to_py()`` yields the python index: ``all``→``:``, ``point``→int
+    (rank-reducing, current nd4j semantics), ``interval``→slice,
+    ``indices``→int array (copy path), ``new_axis``→None.
+    """
+
+    __slots__ = ("_py",)
+
+    def __init__(self, py):
+        self._py = py
+
+    def to_py(self):
+        return self._py
+
+    # ------------------------------------------------------------ factories
+
+    @staticmethod
+    def all() -> "NDArrayIndex":
+        return NDArrayIndex(slice(None))
+
+    @staticmethod
+    def point(i: int) -> "NDArrayIndex":
+        return NDArrayIndex(int(i))
+
+    @staticmethod
+    def interval(start: int, a: int, b: int = None,
+                 inclusive: bool = False) -> "NDArrayIndex":
+        """Java-exact overloads (r5 review — the 3-arg order is the nd4j
+        one, NOT python's): ``interval(from, to)`` → [from, to);
+        ``interval(from, stride, to)`` → strided; ``inclusive`` closes the
+        end, as in ``NDArrayIndex.interval(from, to, true)``."""
+        if b is None:
+            stride, end = 1, int(a)
+        else:
+            stride, end = int(a), int(b)
+        end += 1 if inclusive else 0
+        return NDArrayIndex(slice(int(start), end, stride))
+
+    @staticmethod
+    def indices(*idx) -> "NDArrayIndex":
+        if len(idx) == 1 and isinstance(idx[0], (list, tuple, np.ndarray)):
+            idx = tuple(idx[0])
+        return NDArrayIndex(np.asarray(idx, np.int64))
+
+    @staticmethod
+    def new_axis() -> "NDArrayIndex":
+        return NDArrayIndex(None)
+
+    newAxis = new_axis
+
+
+def resolve_indices(indices):
+    """NDArrayIndex/raw mix → python index tuple for NDArray.__getitem__."""
+    out = []
+    for ix in indices:
+        out.append(ix.to_py() if isinstance(ix, NDArrayIndex) else ix)
+    return tuple(out)
+
+
+class Condition:
+    """jnp-traceable elementwise predicate with the nd4j Condition contract
+    (callable → BOOL mask; ``value`` echoes the comparison operand)."""
+
+    __slots__ = ("_fn", "value")
+
+    def __init__(self, fn, value=None):
+        self._fn = fn
+        self.value = value
+
+    def __call__(self, x):
+        return self._fn(jnp.asarray(x))
+
+
+class Conditions:
+    """Factory twins of ``org.nd4j.linalg.indexing.conditions.Conditions``."""
+
+    @staticmethod
+    def equals(v) -> Condition:
+        return Condition(lambda x: x == v, v)
+
+    @staticmethod
+    def eps_equals(v, eps: float = 1e-5) -> Condition:
+        return Condition(lambda x: jnp.abs(x - v) <= eps, v)
+
+    epsEquals = eps_equals
+
+    @staticmethod
+    def not_equals(v) -> Condition:
+        return Condition(lambda x: x != v, v)
+
+    notEquals = not_equals
+
+    @staticmethod
+    def greater_than(v) -> Condition:
+        return Condition(lambda x: x > v, v)
+
+    greaterThan = greater_than
+
+    @staticmethod
+    def greater_than_or_equal(v) -> Condition:
+        return Condition(lambda x: x >= v, v)
+
+    greaterThanOrEqual = greater_than_or_equal
+
+    @staticmethod
+    def less_than(v) -> Condition:
+        return Condition(lambda x: x < v, v)
+
+    lessThan = less_than
+
+    @staticmethod
+    def less_than_or_equal(v) -> Condition:
+        return Condition(lambda x: x <= v, v)
+
+    lessThanOrEqual = less_than_or_equal
+
+    @staticmethod
+    def abs_greater_than(v) -> Condition:
+        return Condition(lambda x: jnp.abs(x) > v, v)
+
+    absGreaterThan = abs_greater_than
+
+    @staticmethod
+    def abs_less_than(v) -> Condition:
+        return Condition(lambda x: jnp.abs(x) < v, v)
+
+    absLessThan = abs_less_than
+
+    @staticmethod
+    def abs_greater_than_or_equal(v) -> Condition:
+        return Condition(lambda x: jnp.abs(x) >= v, v)
+
+    absGreaterThanOrEqual = abs_greater_than_or_equal
+
+    @staticmethod
+    def abs_less_than_or_equal(v) -> Condition:
+        return Condition(lambda x: jnp.abs(x) <= v, v)
+
+    absLessThanOrEqual = abs_less_than_or_equal
+
+    @staticmethod
+    def is_nan() -> Condition:
+        return Condition(jnp.isnan)
+
+    isNan = is_nan
+
+    @staticmethod
+    def is_infinite() -> Condition:
+        return Condition(jnp.isinf)
+
+    isInfinite = is_infinite
+
+    @staticmethod
+    def is_finite() -> Condition:
+        return Condition(jnp.isfinite)
+
+    isFinite = is_finite
+
+    @staticmethod
+    def not_finite() -> Condition:
+        return Condition(lambda x: ~jnp.isfinite(x))
+
+    notFinite = not_finite
+
+
+class BooleanIndexing:
+    """Static twins of ``org.nd4j.linalg.indexing.BooleanIndexing``."""
+
+    @staticmethod
+    def apply_where(arr, condition, value) -> "NDArray":  # noqa: F821
+        """In-place: where condition holds on ``arr``, write ``value``
+        (scalar or same-shape array) — BooleanIndexing.applyWhere."""
+        return arr.replace_where(value, condition)
+
+    applyWhere = apply_where
+
+    @staticmethod
+    def replace_where(to, put, condition) -> "NDArray":  # noqa: F821
+        """In-place on ``to``: where condition holds on ``to``, take the
+        corresponding element of ``put`` — BooleanIndexing.replaceWhere."""
+        return to.replace_where(put, condition)
+
+    replaceWhere = replace_where
+
+    @staticmethod
+    def and_(arr, condition) -> bool:
+        return bool(jnp.all(condition(arr.jax)))
+
+    @staticmethod
+    def or_(arr, condition) -> bool:
+        return bool(jnp.any(condition(arr.jax)))
+
+    @staticmethod
+    def first_index(arr, condition) -> int:
+        """Flattened index of the first match, -1 if none (returns a host
+        int — the reference returns a scalar INDArray)."""
+        mask = np.asarray(condition(arr.jax)).ravel()
+        hits = np.flatnonzero(mask)
+        return int(hits[0]) if hits.size else -1
+
+    firstIndex = first_index
+
+    @staticmethod
+    def last_index(arr, condition) -> int:
+        mask = np.asarray(condition(arr.jax)).ravel()
+        hits = np.flatnonzero(mask)
+        return int(hits[-1]) if hits.size else -1
+
+    lastIndex = last_index

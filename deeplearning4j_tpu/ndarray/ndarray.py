@@ -255,11 +255,20 @@ class NDArray:
     def get_scalar(self, *indices) -> "NDArray":
         return self[tuple(int(i) for i in indices)]
 
+    def _pointwise_index(self, indices):
+        """DL4J accessor rule: a single index on a rank>1 array is a LINEAR
+        (order-respecting) offset — getDouble(5) walks the buffer in this
+        array's 'c'/'f' order (BaseNDArray.getDouble(long))."""
+        if len(indices) == 1 and self.rank > 1:
+            return np.unravel_index(int(indices[0]), self.shape,
+                                    order="F" if self._order == "f" else "C")
+        return tuple(int(i) for i in indices)
+
     def get_double(self, *indices) -> float:
-        return float(self.jax[tuple(int(i) for i in indices)])
+        return float(self.jax[self._pointwise_index(indices)])
 
     def get_int(self, *indices) -> int:
-        return int(self.jax[tuple(int(i) for i in indices)])
+        return int(self.jax[self._pointwise_index(indices)])
 
     def put_scalar(self, indices, value) -> "NDArray":
         if isinstance(indices, (int, np.integer)):
@@ -879,6 +888,488 @@ class NDArray:
         if hasattr(j, "block_until_ready"):
             j.block_until_ready()
         return self
+
+    # =================================================== J1 surface wave 2
+    # (VERDICT r5 task #3: get/put(NDArrayIndex) matrix, BooleanIndexing /
+    # Conditions integration, broadcast_* family, and the accessor tail —
+    # DL4J-exact semantics per ref: org.nd4j.linalg.api.ndarray.BaseNDArray,
+    # acceptance-tested in tests/test_ndarray_semantics.py against named
+    # Nd4jTestsC cases.)
+
+    # ------------------------------------------------- get/put(NDArrayIndex)
+
+    def get(self, *indices) -> "NDArray":
+        """INDArray.get(INDArrayIndex...): all/point/interval combinations
+        return aliasing VIEWS (writes visible in the parent); indices()
+        terms fall to the copy path — the reference's view-vs-copy split."""
+        from .indexing import resolve_indices
+
+        return self[resolve_indices(indices)]
+
+    def put(self, indices, value) -> "NDArray":
+        """INDArray.put(INDArrayIndex[], INDArray) — also accepts the
+        put(row, col, value) scalar form when given plain ints."""
+        from .indexing import resolve_indices
+
+        if isinstance(indices, (int, np.integer)):  # put(i, element) form
+            return self.put_scalar(indices, value)
+        if not isinstance(indices, (tuple, list)):
+            indices = (indices,)
+        self[resolve_indices(indices)] = value
+        return self
+
+    def put_slice(self, i: int, arr) -> "NDArray":
+        """BaseNDArray.putSlice: overwrite the i-th dim-0 subtensor."""
+        self[i] = arr
+        return self
+
+    putSlice = put_slice
+
+    def put_where(self, comp, put, condition) -> "NDArray":
+        """INDArray.putWhere(comp, put, condition): COPY of self taking
+        ``put`` elements where the condition holds on ``comp``."""
+        mask = condition(jnp.asarray(_unwrap(comp)))
+        rep = jnp.broadcast_to(jnp.asarray(_unwrap(put), self.jax.dtype), self.shape)
+        return NDArray(jnp.where(mask, rep, self.jax), order=self._order)
+
+    putWhere = put_where
+
+    def put_where_with_mask(self, mask, put) -> "NDArray":
+        """INDArray.putWhereWithMask: copy taking ``put`` where mask != 0."""
+        m = jnp.asarray(_unwrap(mask)).astype(bool)
+        rep = jnp.broadcast_to(jnp.asarray(_unwrap(put), self.jax.dtype), self.shape)
+        return NDArray(jnp.where(m, rep, self.jax), order=self._order)
+
+    putWhereWithMask = put_where_with_mask
+
+    def cond(self, condition) -> "NDArray":
+        """INDArray.cond(Condition): BOOL array where the condition holds."""
+        return NDArray(condition(self.jax), order=self._order)
+
+    def assign_if(self, other, condition) -> "NDArray":
+        """BaseNDArray.assignIf: in place, take ``other`` where the
+        condition holds on SELF (keep own value elsewhere)."""
+        o = jnp.broadcast_to(jnp.asarray(_unwrap(other), self.jax.dtype), self.shape)
+        return self._set_value(jnp.where(condition(self.jax), o, self.jax))
+
+    assignIf = assign_if
+
+    def get_float(self, *indices) -> float:
+        return self.get_double(*indices)
+
+    getFloat = get_float
+    getDouble = get_double
+    getInt = get_int
+
+    def get_long(self, *indices) -> int:
+        return self.get_int(*indices)
+
+    getLong = get_long
+
+    # ------------------------------------------------------ vector iteration
+
+    def vector_along_dimension(self, index: int, dim: int) -> "NDArray":
+        """BaseNDArray.vectorAlongDimension — the index-th 1-D view along
+        ``dim`` (C-order iteration of the remaining dims)."""
+        return self.tensor_along_dimension(index, dim)
+
+    vectorAlongDimension = vector_along_dimension
+
+    def vectors_along_dimension(self, dim: int) -> int:
+        return self.tensors_along_dimension(dim)
+
+    vectorsAlongDimension = vectors_along_dimension
+
+    tensorAlongDimension = tensor_along_dimension
+    tensorsAlongDimension = tensors_along_dimension
+
+    def slices(self) -> int:
+        """BaseNDArray.slices(): number of dim-0 subtensors."""
+        return self.shape[0]
+
+    # --------------------------------------------------- arithmetic tail
+
+    def rsub_row_vector(self, v):
+        return self._rowcol(v, lambda a, b: b - a, 1)
+
+    rsubRowVector = rsub_row_vector
+
+    def rsub_column_vector(self, v):
+        return self._rowcol(v, lambda a, b: b - a, 0)
+
+    rsubColumnVector = rsub_column_vector
+
+    def rdiv_row_vector(self, v):
+        return self._rowcol(v, lambda a, b: b / a, 1)
+
+    rdivRowVector = rdiv_row_vector
+
+    def rdiv_column_vector(self, v):
+        return self._rowcol(v, lambda a, b: b / a, 0)
+
+    rdivColumnVector = rdiv_column_vector
+
+    def rsubi_row_vector(self, v):
+        return self._set_value(self.rsub_row_vector(v).jax)
+
+    rsubiRowVector = rsubi_row_vector
+
+    def rsubi_column_vector(self, v):
+        return self._set_value(self.rsub_column_vector(v).jax)
+
+    rsubiColumnVector = rsubi_column_vector
+
+    def rdivi_row_vector(self, v):
+        return self._set_value(self.rdiv_row_vector(v).jax)
+
+    rdiviRowVector = rdivi_row_vector
+
+    def rdivi_column_vector(self, v):
+        return self._set_value(self.rdiv_column_vector(v).jax)
+
+    rdiviColumnVector = rdivi_column_vector
+
+    def fmodi(self, o):
+        return self._binary_i(o, jnp.fmod)
+
+    def eps(self, other, eps_val: float = 1e-5) -> "NDArray":
+        """INDArray.eps: elementwise |a-b| < eps → BOOL."""
+        o = jnp.asarray(_unwrap(other))
+        return NDArray(jnp.abs(self.jax - o) < eps_val, order=self._order)
+
+    def epsi(self, other, eps_val: float = 1e-5) -> "NDArray":
+        return self._set_value(self.eps(other, eps_val).jax)
+
+    def repmat(self, *reps) -> "NDArray":
+        """BaseNDArray.repmat (matlab-style tile)."""
+        return self.tile(*reps)
+
+    # ------------------------------------------------ broadcast_* family
+    # (the Broadcast op family over a TAD dimension set — nd4j exposes these
+    # as BroadcastAddOp etc. over INDArray; SURVEY §2.2 J1/VERDICT r4 #2)
+
+    def _bcast(self, other, dims, fn) -> "NDArray":
+        o = jnp.asarray(_unwrap(other))
+        dims = tuple(d % self.rank for d in dims) if dims else tuple(
+            range(self.rank - o.ndim, self.rank))
+        shape = [1] * self.rank
+        for ax, d in enumerate(sorted(dims)):
+            shape[d] = o.shape[ax] if o.ndim else 1
+        return NDArray(fn(self.jax, o.reshape(shape)), order=self._order)
+
+    def broadcast_add(self, other, *dims):
+        """Broadcast ``other`` along ``dims`` of self, then add (nd4j
+        BroadcastAddOp semantics; dims default to trailing alignment)."""
+        return self._bcast(other, dims, jnp.add)
+
+    def broadcast_sub(self, other, *dims):
+        return self._bcast(other, dims, jnp.subtract)
+
+    def broadcast_mul(self, other, *dims):
+        return self._bcast(other, dims, jnp.multiply)
+
+    def broadcast_div(self, other, *dims):
+        return self._bcast(other, dims, jnp.divide)
+
+    def broadcast_rsub(self, other, *dims):
+        return self._bcast(other, dims, lambda a, b: b - a)
+
+    def broadcast_rdiv(self, other, *dims):
+        return self._bcast(other, dims, lambda a, b: b / a)
+
+    def broadcast_copy(self, other, *dims):
+        return self._bcast(other, dims, lambda a, b: jnp.broadcast_to(b, a.shape))
+
+    def broadcast_equal(self, other, *dims):
+        return self._bcast(other, dims, jnp.equal)
+
+    def broadcast_not_equal(self, other, *dims):
+        return self._bcast(other, dims, jnp.not_equal)
+
+    def broadcast_gt(self, other, *dims):
+        return self._bcast(other, dims, jnp.greater)
+
+    def broadcast_gte(self, other, *dims):
+        return self._bcast(other, dims, jnp.greater_equal)
+
+    def broadcast_lt(self, other, *dims):
+        return self._bcast(other, dims, jnp.less)
+
+    def broadcast_lte(self, other, *dims):
+        return self._bcast(other, dims, jnp.less_equal)
+
+    # ----------------------------------------------------- reductions tail
+
+    def prod_number(self) -> float:
+        return float(jnp.prod(self.jax))
+
+    prodNumber = prod_number
+
+    def amax_number(self) -> float:
+        return float(jnp.max(jnp.abs(self.jax)))
+
+    amaxNumber = amax_number
+
+    def amin_number(self) -> float:
+        return float(jnp.min(jnp.abs(self.jax)))
+
+    aminNumber = amin_number
+
+    def amean_number(self) -> float:
+        return float(jnp.mean(jnp.abs(self.jax)))
+
+    ameanNumber = amean_number
+
+    def norm_max_number(self) -> float:
+        return float(jnp.max(jnp.abs(self.jax)))
+
+    normmaxNumber = norm_max_number
+    normmax = norm_max
+
+    def amean(self, *dims):
+        return self._reduce(lambda x, axis, keepdims: jnp.mean(
+            jnp.abs(x), axis=axis, keepdims=keepdims), dims)
+
+    def entropy(self, *dims):
+        """INDArray.entropy(int... dims): -Σ p log p along dims."""
+        return self._reduce(lambda x, axis, keepdims: -jnp.sum(
+            x * jnp.log(x), axis=axis, keepdims=keepdims), dims)
+
+    def log_entropy(self, *dims):
+        return self._reduce(lambda x, axis, keepdims: jnp.log(-jnp.sum(
+            x * jnp.log(x), axis=axis, keepdims=keepdims)), dims)
+
+    logEntropy = log_entropy
+
+    def shannon_entropy(self, *dims):
+        """-Σ p log2 p (the reference's ShannonEntropy reduction)."""
+        return self._reduce(lambda x, axis, keepdims: -jnp.sum(
+            x * jnp.log2(x), axis=axis, keepdims=keepdims), dims)
+
+    shannonEntropy = shannon_entropy
+
+    def shannon_entropy_number(self) -> float:
+        return float(-jnp.sum(self.jax * jnp.log2(self.jax)))
+
+    shannonEntropyNumber = shannon_entropy_number
+
+    def log_entropy_number(self) -> float:
+        return float(jnp.log(-jnp.sum(self.jax * jnp.log(self.jax))))
+
+    logEntropyNumber = log_entropy_number
+
+    entropyNumber = entropy_number
+
+    def median(self, *dims):
+        return self._reduce(lambda x, axis, keepdims: jnp.median(
+            x, axis=axis, keepdims=keepdims), dims)
+
+    def percentile(self, q: float, *dims):
+        return self._reduce(lambda x, axis, keepdims: jnp.percentile(
+            x, q, axis=axis, keepdims=keepdims), dims)
+
+    def cumsumi(self, dim: int) -> "NDArray":
+        return self._set_value(self.cumsum(dim).jax)
+
+    def cumprodi(self, dim: int) -> "NDArray":
+        return self._set_value(self.cumprod(dim).jax)
+
+    # ------------------------------------------------- dtype-class predicates
+
+    def is_r(self) -> bool:
+        """DataType class check: real (floating) — INDArray.isR()."""
+        return jnp.issubdtype(self.jax.dtype, jnp.floating)
+
+    isR = is_r
+
+    def is_z(self) -> bool:
+        """Integer dtype — INDArray.isZ()."""
+        return jnp.issubdtype(self.jax.dtype, jnp.integer)
+
+    isZ = is_z
+
+    def is_b(self) -> bool:
+        """Boolean dtype — INDArray.isB()."""
+        return self.jax.dtype == jnp.bool_
+
+    isB = is_b
+
+    def is_s(self) -> bool:
+        """String dtype — always False (no string tensors on device; the
+        datavec string pipeline handles text host-side)."""
+        return False
+
+    isS = is_s
+
+    def is_sparse(self) -> bool:
+        return False  # dense XLA buffers only (INDArray.isSparse)
+
+    isSparse = is_sparse
+
+    # --------------------------------------------- lifecycle/workspace tail
+    # (workspace semantics are merged into the XLA allocator per SURVEY
+    # §2.9 N4 — these keep the reference signatures as cheap truths/no-ops)
+
+    def is_attached(self) -> bool:
+        return False  # never workspace-attached: buffers are XLA-owned
+
+    isAttached = is_attached
+
+    def is_compressed(self) -> bool:
+        return False
+
+    isCompressed = is_compressed
+
+    def closeable(self) -> bool:
+        return self._root is None  # views don't own their buffer
+
+    def close(self) -> None:
+        if self._root is None:
+            self._buf = None  # drop the device reference (INDArray.close)
+
+    def was_closed(self) -> bool:
+        return self._root is None and self._buf is None
+
+    wasClosed = was_closed
+
+    def migrate(self) -> "NDArray":
+        return self
+
+    def leverage(self) -> "NDArray":
+        return self
+
+    def leverage_to(self, workspace_id: str) -> "NDArray":
+        return self
+
+    leverageTo = leverage_to
+
+    def ulike(self) -> "NDArray":
+        """Uninitialized same-shape/dtype array (INDArray.ulike) — zeroed
+        here; XLA has no uninitialized allocation."""
+        return NDArray(jnp.zeros(self.shape, self.jax.dtype), order=self._order)
+
+    def like(self) -> "NDArray":
+        return self.ulike()
+
+    # ------------------------------------------------------- layout tail
+
+    def element_wise_stride(self) -> int:
+        return 1  # dense logical layout (physical layout is XLA's)
+
+    elementWiseStride = element_wise_stride
+
+    def get_leading_ones(self) -> int:
+        n = 0
+        for s in self.shape:
+            if s != 1:
+                break
+            n += 1
+        return n
+
+    getLeadingOnes = get_leading_ones
+
+    def get_trailing_ones(self) -> int:
+        n = 0
+        for s in reversed(self.shape):
+            if s != 1:
+                break
+            n += 1
+        return n
+
+    getTrailingOnes = get_trailing_ones
+
+    def shape_info_to_string(self) -> str:
+        return (f"[{self.rank},{','.join(map(str, self.shape))},"
+                f"{','.join(map(str, self.stride()))},{self._order}]")
+
+    shapeInfoToString = shape_info_to_string
+
+    def transposei(self) -> "NDArray":
+        return self._set_self(self.transpose())
+
+    def is_row_vector_or_scalar(self) -> bool:
+        return self.is_row_vector() or self.is_scalar()
+
+    isRowVectorOrScalar = is_row_vector_or_scalar
+
+    def is_column_vector_or_scalar(self) -> bool:
+        return self.is_column_vector() or self.is_scalar()
+
+    isColumnVectorOrScalar = is_column_vector_or_scalar
+
+    def is_vector_or_scalar(self) -> bool:
+        return self.is_vector() or self.is_scalar()
+
+    isVectorOrScalar = is_vector_or_scalar
+
+    # ------------------------------------------------------ conversion tail
+
+    def to_long_vector(self):
+        return self._to_vector(np.int64)
+
+    toLongVector = to_long_vector
+
+    def to_long_matrix(self):
+        return self._to_matrix(np.int64)
+
+    toLongMatrix = to_long_matrix
+
+    def to_int_matrix(self):
+        return self._to_matrix(np.int32)
+
+    toIntMatrix = to_int_matrix
+
+    def match(self, value, condition) -> "NDArray":
+        """INDArray.match(n, condition): BOOL mask where the condition on
+        (self, value) holds — value is carried by the Condition here."""
+        return NDArray(condition(self.jax), order=self._order)
+
+    # ------------------------------------- Java-name aliases (J1 spellings)
+    # The reference API is camelCase; both spellings resolve, like the
+    # putScalar/put_scalar pairs earlier waves registered.
+
+    dataType = data_type
+    sumNumber = sum_number
+    meanNumber = mean_number
+    maxNumber = max_number
+    minNumber = min_number
+    stdNumber = std_number
+    varNumber = var_number
+    norm1Number = norm1_number
+    norm2Number = norm2_number
+    getRow = get_row
+    getColumn = get_column
+    getRows = get_rows
+    getColumns = get_columns
+    putRow = put_row
+    putColumn = put_column
+    getScalar = get_scalar
+    addRowVector = add_row_vector
+    subRowVector = sub_row_vector
+    mulRowVector = mul_row_vector
+    divRowVector = div_row_vector
+    addColumnVector = add_column_vector
+    subColumnVector = sub_column_vector
+    mulColumnVector = mul_column_vector
+    divColumnVector = div_column_vector
+    addiRowVector = addi_row_vector
+    subiRowVector = subi_row_vector
+    muliRowVector = muli_row_vector
+    diviRowVector = divi_row_vector
+    addiColumnVector = addi_column_vector
+    subiColumnVector = subi_column_vector
+    muliColumnVector = muli_column_vector
+    diviColumnVector = divi_column_vector
+    isVector = is_vector
+    isMatrix = is_matrix
+    isScalar = is_scalar
+    isRowVector = is_row_vector
+    isColumnVector = is_column_vector
+    isEmpty = is_empty
+    isView = is_view
+    equalShapes = equal_shapes
+    isInfinite = is_infinite
+    isNaN = is_nan
 
 
 def _fravel(buf):
