@@ -1,4 +1,4 @@
-from . import activations, constraints, dropout, earlystopping, losses, transfer, updaters, weights
+from . import activations, capsules, constraints, dropout, earlystopping, losses, transfer, updaters, weights
 from .layers_ext import (
     CenterLossOutputLayer,
     Convolution3D,
@@ -6,6 +6,26 @@ from .layers_ext import (
     LocallyConnected2D,
     PReLULayer,
     Subsampling3DLayer,
+)
+from .layers_tail import (
+    Cnn3DLossLayer,
+    CnnLossLayer,
+    Cropping1D,
+    Cropping3D,
+    Deconvolution3D,
+    ElementWiseMultiplicationLayer,
+    FrozenLayerWithBackprop,
+    GravesBidirectionalLSTM,
+    MaskLayer,
+    MaskZeroLayer,
+    RnnLossLayer,
+    SpaceToBatch,
+    SpaceToDepth,
+    TimeDistributed,
+    Upsampling1D,
+    Upsampling3D,
+    ZeroPadding1DLayer,
+    ZeroPadding3DLayer,
 )
 from .conf import NeuralNetConfiguration, MultiLayerConfiguration
 from .attention_layers import (

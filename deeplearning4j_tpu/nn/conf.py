@@ -155,6 +155,11 @@ class Layer:
 
             dd = dict(d["dropout"])
             d["dropout"] = getattr(dropout_mod, dd.pop("@dropout"))(**dd)
+        for k, v in list(d.items()):
+            # nested layer configs (Bidirectional.fwd, TimeDistributed/
+            # MaskZeroLayer/FrozenLayerWithBackprop.underlying) recurse
+            if isinstance(v, dict) and "@class" in v:
+                d[k] = Layer.from_json(v)
         flds = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in flds})
 

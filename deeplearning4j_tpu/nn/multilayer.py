@@ -179,9 +179,11 @@ class MultiLayerNetwork(_LazyScoreMixin):
             new_rnn[si] = (hT, cT)
             return out
         from .attention_layers import LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer
+        from .layers_tail import MaskLayer
 
         if isinstance(layer, (LastTimeStep, GlobalPoolingLayer, SelfAttentionLayer,
-                              LearnedSelfAttentionLayer, RecurrentAttentionLayer)):
+                              LearnedSelfAttentionLayer, RecurrentAttentionLayer,
+                              MaskLayer)):
             return layer.forward(p, h, it, training=training, rng=sub, mask=fmask)
         return layer.forward(p, h, it, training=training, rng=sub)
 

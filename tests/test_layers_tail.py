@@ -1,0 +1,264 @@
+"""Layer-config tail: forward semantics + gradient flow + serde round-trip.
+
+Reference parity: org.deeplearning4j.nn.conf.layers.* (SURVEY §2.4 C1;
+VERDICT r4 missing #6). Forward outputs are checked against independent
+numpy math; every parameterized layer gets a grad-flow check through
+jax.grad; JSON round-trip covers the nested-wrapper configs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import InputType, Layer
+from deeplearning4j_tpu.nn.layers_tail import (
+    Cnn3DLossLayer,
+    CnnLossLayer,
+    Convolution2D,
+    Cropping1D,
+    Cropping3D,
+    Deconvolution3D,
+    ElementWiseMultiplicationLayer,
+    FrozenLayerWithBackprop,
+    GravesBidirectionalLSTM,
+    MaskLayer,
+    MaskZeroLayer,
+    Pooling1D,
+    Pooling2D,
+    RnnLossLayer,
+    SpaceToBatch,
+    SpaceToDepth,
+    TimeDistributed,
+    Upsampling1D,
+    Upsampling3D,
+    ZeroPadding1DLayer,
+    ZeroPadding3DLayer,
+)
+
+R = np.random.RandomState(3)
+RNN_X = jnp.asarray(R.randn(2, 3, 5), jnp.float32)     # [B,C,T]
+RNN_IT = InputType.recurrent(3, 5)
+CNN_X = jnp.asarray(R.randn(2, 4, 6, 6), jnp.float32)  # [B,C,H,W]
+CNN_IT = InputType.convolutional(6, 6, 4)
+C3D_X = jnp.asarray(R.randn(1, 2, 4, 4, 4), jnp.float32)
+C3D_IT = InputType.convolutional3d(4, 4, 4, 2)
+
+
+def _grad_flows(layer, params, x, it):
+    g = jax.grad(lambda p, xx: jnp.sum(
+        layer.forward(p, xx, it, training=False) ** 2), argnums=(0, 1))(params, x)
+    leaves = jax.tree.leaves(g)
+    assert leaves and all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+    return g
+
+
+class TestRecurrentTail:
+    def test_graves_bidirectional_sums_directions(self):
+        """The reference's GravesBidirectionalLSTMLayer adds fwd+bwd passes."""
+        layer = GravesBidirectionalLSTM(n_in=3, n_out=4)
+        p = layer.init_params(jax.random.key(0), RNN_IT)
+        out = layer.forward(p, RNN_X, RNN_IT, training=False)
+        assert out.shape == (2, 4, 5)
+        # manual: run the inner cell both ways and add
+        cell = layer._cell()
+        f = cell.forward(p["fwd"], RNN_X, RNN_IT, training=False)
+        b = jnp.flip(cell.forward(p["bwd"], jnp.flip(RNN_X, 2), RNN_IT,
+                                  training=False), 2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(f + b), atol=1e-6)
+        assert layer.output_type(RNN_IT).size == 4
+        _grad_flows(layer, p, RNN_X, RNN_IT)
+
+    def test_time_distributed_matches_per_step(self):
+        from deeplearning4j_tpu.nn.conf import DenseLayer
+
+        layer = TimeDistributed(underlying=DenseLayer(n_in=3, n_out=6,
+                                                      activation="relu"))
+        p = layer.init_params(jax.random.key(1), RNN_IT)
+        out = layer.forward(p, RNN_X, RNN_IT, training=False)
+        assert out.shape == (2, 6, 5)
+        step2 = layer.underlying.forward(p, RNN_X[:, :, 2],
+                                         InputType.feed_forward(3), training=False)
+        np.testing.assert_allclose(np.asarray(out[:, :, 2]), np.asarray(step2),
+                                   atol=1e-6)
+        assert layer.output_type(RNN_IT).size == 6
+        _grad_flows(layer, p, RNN_X, RNN_IT)
+
+
+class TestMaskLayers:
+    def test_mask_layer(self):
+        layer = MaskLayer()
+        mask = jnp.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+        out = layer.forward({}, RNN_X, RNN_IT, training=False, mask=mask)
+        np.testing.assert_array_equal(np.asarray(out[0, :, 3:]), 0.0)
+        np.testing.assert_allclose(np.asarray(out[1]), np.asarray(RNN_X[1]))
+        assert np.allclose(np.asarray(layer.forward({}, RNN_X, RNN_IT,
+                                                    training=False)), RNN_X)
+
+    def test_mask_zero_layer(self):
+        from deeplearning4j_tpu.nn.conf import SimpleRnn
+
+        layer = MaskZeroLayer(underlying=SimpleRnn(n_in=3, n_out=4),
+                              mask_value=9.0)
+        x = RNN_X.at[:, :, -1].set(9.0)  # last step = sentinel on every feature
+        p = layer.init_params(jax.random.key(2), RNN_IT)
+        out = layer.forward(p, x, RNN_IT, training=False)
+        # the underlying layer must see zeros at the sentinel step
+        ref = layer.underlying.forward(p, x.at[:, :, -1].set(0.0), RNN_IT,
+                                       training=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+class TestLossLayers:
+    def test_rnn_loss_layer_masked(self):
+        layer = RnnLossLayer(loss="mse")
+        labels = jnp.zeros_like(RNN_X)
+        mask = jnp.asarray([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+        loss = layer.compute_loss({}, RNN_X, labels, RNN_IT, training=False,
+                                  mask=mask)
+        x = np.asarray(RNN_X)
+        m = np.asarray(mask)
+        # nd4j LossMSE contract: SUM over outputs per step, mean over
+        # unmasked example-steps
+        expected = (((x ** 2).sum(1) * m).sum()) / m.sum()
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+    def test_cnn_loss_layer(self):
+        layer = CnnLossLayer(loss="mse")
+        labels = jnp.zeros_like(CNN_X)
+        loss = layer.compute_loss({}, CNN_X, labels, CNN_IT, training=False)
+        np.testing.assert_allclose(float(loss),
+                                   (np.asarray(CNN_X) ** 2).sum(1).mean(),
+                                   rtol=1e-5)
+
+    def test_cnn3d_loss_layer(self):
+        layer = Cnn3DLossLayer(loss="mse")
+        loss = layer.compute_loss({}, C3D_X, jnp.zeros_like(C3D_X), C3D_IT,
+                                  training=False)
+        np.testing.assert_allclose(float(loss),
+                                   (np.asarray(C3D_X) ** 2).sum(1).mean(),
+                                   rtol=1e-5)
+
+
+class TestMiscTail:
+    def test_elementwise_multiplication(self):
+        layer = ElementWiseMultiplicationLayer(n_in=4, n_out=4)
+        it = InputType.feed_forward(4)
+        p = layer.init_params(jax.random.key(3), it)
+        p = {"W": jnp.asarray([1.0, 2.0, 3.0, 4.0]), "b": jnp.ones(4)}
+        x = jnp.ones((2, 4))
+        out = layer.forward(p, x, it, training=False)
+        np.testing.assert_array_equal(np.asarray(out), [[2, 3, 4, 5]] * 2)
+        _grad_flows(layer, p, x, it)
+
+    def test_frozen_with_backprop_delegates_and_freezes(self):
+        from deeplearning4j_tpu.nn.conf import DenseLayer
+
+        layer = FrozenLayerWithBackprop(underlying=DenseLayer(n_in=3, n_out=2))
+        assert layer.frozen is True
+        it = InputType.feed_forward(3)
+        p = layer.init_params(jax.random.key(4), it)
+        out = layer.forward(p, jnp.ones((2, 3)), it, training=False)
+        ref = layer.underlying.forward(p, jnp.ones((2, 3)), it, training=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+        # gradients flow THROUGH (wrt input) even though params are frozen
+        g = jax.grad(lambda xx: jnp.sum(layer.forward(p, xx, it, training=False)))(
+            jnp.ones((2, 3)))
+        assert np.any(np.asarray(g) != 0)
+
+
+class TestSpaceReshapes:
+    def test_space_to_depth_roundtrip_values(self):
+        layer = SpaceToDepth(block_size=2)
+        out = layer.forward({}, CNN_X, CNN_IT, training=False)
+        assert out.shape == (2, 16, 3, 3)
+        # block (0,0) of image 0, channel 0 lands in the first depth group
+        np.testing.assert_allclose(float(out[0, 0, 0, 0]), float(CNN_X[0, 0, 0, 0]))
+        ot = layer.output_type(CNN_IT)
+        assert (ot.height, ot.width, ot.channels) == (3, 3, 16)
+
+    def test_space_to_batch(self):
+        layer = SpaceToBatch(block_size=(2, 2))
+        out = layer.forward({}, CNN_X, CNN_IT, training=False)
+        assert out.shape == (8, 4, 3, 3)
+        np.testing.assert_allclose(np.asarray(out[0, :, 0, 0]),
+                                   np.asarray(CNN_X[0, :, 0, 0]))
+
+
+class TestCropPadUpsample:
+    def test_cropping1d(self):
+        out = Cropping1D(cropping=(1, 2)).forward({}, RNN_X, RNN_IT, training=False)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(RNN_X[:, :, 1:3]))
+
+    def test_cropping3d(self):
+        out = Cropping3D(cropping=(1, 1, 0, 1, 2, 0)).forward(
+            {}, C3D_X, C3D_IT, training=False)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(C3D_X[:, :, 1:3, 0:3, 2:4]))
+
+    def test_zero_padding_1d_3d(self):
+        out = ZeroPadding1DLayer(padding=(2, 1)).forward({}, RNN_X, RNN_IT,
+                                                         training=False)
+        assert out.shape == (2, 3, 8)
+        np.testing.assert_array_equal(np.asarray(out[:, :, :2]), 0.0)
+        out3 = ZeroPadding3DLayer(padding=(1, 0, 0, 1, 2, 2)).forward(
+            {}, C3D_X, C3D_IT, training=False)
+        assert out3.shape == (1, 2, 5, 5, 8)
+
+    def test_upsampling_1d_3d(self):
+        out = Upsampling1D(size=3).forward({}, RNN_X, RNN_IT, training=False)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.repeat(np.asarray(RNN_X), 3, 2))
+        out3 = Upsampling3D(size=(2, 1, 2)).forward({}, C3D_X, C3D_IT,
+                                                    training=False)
+        assert out3.shape == (1, 2, 8, 4, 8)
+
+    def test_deconvolution3d(self):
+        layer = Deconvolution3D(n_in=2, n_out=3, kernel_size=(2, 2, 2),
+                                stride=(2, 2, 2))
+        p = layer.init_params(jax.random.key(5), C3D_IT)
+        out = layer.forward(p, C3D_X, C3D_IT, training=False)
+        assert out.shape == (1, 3, 8, 8, 8)
+        ot = layer.output_type(C3D_IT)
+        assert (ot.depth, ot.height, ot.width, ot.channels) == (8, 8, 8, 3)
+        _grad_flows(layer, p, C3D_X, C3D_IT)
+
+
+class TestAliasesAndSerde:
+    def test_dl4j_alias_classes(self):
+        assert issubclass(Convolution2D, Layer)
+        assert Pooling2D().pooling_type == "max"
+        assert Pooling1D().has_params() is False
+
+    @pytest.mark.parametrize("layer", [
+        GravesBidirectionalLSTM(n_in=3, n_out=4),
+        MaskLayer(),
+        RnnLossLayer(loss="mse"),
+        CnnLossLayer(loss="mse"),
+        ElementWiseMultiplicationLayer(n_in=4, n_out=4),
+        SpaceToDepth(block_size=2),
+        Cropping1D(cropping=(1, 1)),
+        ZeroPadding3DLayer(padding=(1, 1, 1, 1, 1, 1)),
+        Upsampling1D(size=2),
+        Deconvolution3D(n_in=2, n_out=3),
+    ])
+    def test_json_roundtrip(self, layer):
+        d = layer.to_json()
+        back = Layer.from_json(d)
+        assert type(back) is type(layer)
+        assert back.to_json() == d
+
+    def test_nested_wrapper_roundtrip(self):
+        """Layer.from_json recurses nested layer configs (r5 fix — also
+        covers Bidirectional.fwd upstream)."""
+        from deeplearning4j_tpu.nn.conf import DenseLayer
+
+        for wrapper in (TimeDistributed(underlying=DenseLayer(n_in=3, n_out=6)),
+                        FrozenLayerWithBackprop(underlying=DenseLayer(n_in=3, n_out=2)),
+                        MaskZeroLayer(underlying=DenseLayer(n_in=3, n_out=2),
+                                      mask_value=9.0)):
+            back = Layer.from_json(wrapper.to_json())
+            assert type(back) is type(wrapper)
+            assert isinstance(back.underlying, DenseLayer)
+            assert back.underlying.n_out == wrapper.underlying.n_out
