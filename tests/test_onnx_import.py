@@ -1,0 +1,295 @@
+"""ONNX import goldens (VERDICT r4 missing #3, SURVEY §0.5 J14).
+
+The golden .onnx bytes are BUILT through the importer's own wire-format
+writer (`wire_field`) — genuine ONNX protobuf wire encoding end to end —
+because this image ships neither ``onnx`` nor ``onnxscript`` (torch cannot
+export). Expected outputs come from independent numpy implementations.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.onnx_import import (
+    OnnxGraphMapper,
+    OnnxImportError,
+    wire_field,
+)
+
+R = np.random.RandomState(11)
+
+
+# ------------------------------------------------------- wire-format builders
+
+
+def t_proto(name, arr):
+    arr = np.asarray(arr)
+    dt = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
+          np.dtype(np.int32): 6}[arr.dtype]
+    out = b"".join(wire_field(1, d, 0) for d in arr.shape)
+    out += wire_field(2, dt, 0)
+    out += wire_field(8, name)
+    out += wire_field(9, arr.tobytes())
+    return out
+
+
+def a_int(name, v):
+    return wire_field(1, name) + wire_field(3, v, 0) + wire_field(20, 2, 0)
+
+
+def a_ints(name, vs):
+    return (wire_field(1, name) + b"".join(wire_field(8, v, 0) for v in vs)
+            + wire_field(20, 7, 0))
+
+
+def a_float(name, v):
+    return wire_field(1, name) + wire_field(2, v, 5) + wire_field(20, 1, 0)
+
+
+def a_tensor(name, arr):
+    return wire_field(1, name) + wire_field(5, t_proto("", arr)) + wire_field(20, 4, 0)
+
+
+def node(op_type, inputs, outputs, *attrs, name=""):
+    out = b"".join(wire_field(1, i) for i in inputs)
+    out += b"".join(wire_field(2, o) for o in outputs)
+    out += wire_field(3, name or outputs[0])
+    out += wire_field(4, op_type)
+    out += b"".join(wire_field(5, a) for a in attrs)
+    return out
+
+
+def value_info(name, shape):
+    dims = b"".join(wire_field(1, wire_field(1, d, 0)) for d in shape)
+    ttype = wire_field(1, 1, 0) + wire_field(2, dims)
+    return wire_field(1, name) + wire_field(2, wire_field(1, ttype))
+
+
+def model(nodes, initializers, inputs, outputs):
+    g = b"".join(wire_field(1, n) for n in nodes)
+    g += wire_field(2, "g")
+    g += b"".join(wire_field(5, t) for t in initializers)
+    g += b"".join(wire_field(11, vi) for vi in inputs)
+    g += b"".join(wire_field(12, wire_field(1, o)) for o in outputs)
+    return wire_field(1, 8, 0) + wire_field(8, wire_field(2, 17, 0)) + wire_field(7, g)
+
+
+# ----------------------------------------------------------- numpy reference
+
+
+def np_conv(x, w, b, pad=1):
+    N, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((N, O, H, W), np.float32)
+    for i in range(H):
+        for j in range(W):
+            patch = xp[:, :, i:i + kh, j:j + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out + b[None, :, None, None]
+
+
+def np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# -------------------------------------------------------------------- tests
+
+
+class TestOnnxCnnGolden:
+    def _build(self):
+        w = (R.randn(4, 3, 3, 3) * 0.3).astype(np.float32)
+        b = R.randn(4).astype(np.float32)
+        scale = (R.rand(4) + 0.5).astype(np.float32)
+        bias = R.randn(4).astype(np.float32)
+        mean = R.randn(4).astype(np.float32)
+        var = (R.rand(4) + 0.5).astype(np.float32)
+        fc_w = (R.randn(4, 5) * 0.4).astype(np.float32)
+        fc_b = R.randn(5).astype(np.float32)
+        nodes = [
+            node("Conv", ["x", "w", "b"], ["c1"],
+                 a_ints("pads", [1, 1, 1, 1]), a_ints("strides", [1, 1]),
+                 a_ints("kernel_shape", [3, 3])),
+            node("BatchNormalization", ["c1", "scale", "bias", "mean", "var"],
+                 ["bn"], a_float("epsilon", 1e-5)),
+            node("Relu", ["bn"], ["r1"]),
+            node("MaxPool", ["r1"], ["p1"], a_ints("kernel_shape", [2, 2]),
+                 a_ints("strides", [2, 2])),
+            node("GlobalAveragePool", ["p1"], ["gap"]),
+            node("Flatten", ["gap"], ["flat"], a_int("axis", 1)),
+            node("Gemm", ["flat", "fc_w", "fc_b"], ["fc"],
+                 a_float("alpha", 1.0), a_float("beta", 1.0)),
+            node("Softmax", ["fc"], ["probs"], a_int("axis", -1)),
+        ]
+        inits = [t_proto("w", w), t_proto("b", b), t_proto("scale", scale),
+                 t_proto("bias", bias), t_proto("mean", mean),
+                 t_proto("var", var), t_proto("fc_w", fc_w), t_proto("fc_b", fc_b)]
+        mb = model(nodes, inits, [value_info("x", (2, 3, 8, 8))], ["probs"])
+        return mb, (w, b, scale, bias, mean, var, fc_w, fc_b)
+
+    def test_cnn_forward_matches_numpy(self):
+        mb, (w, b, scale, bias, mean, var, fc_w, fc_b) = self._build()
+        g = OnnxGraphMapper.import_model(mb)
+        x = R.randn(2, 3, 8, 8).astype(np.float32)
+        got = g.output({"x": x})["probs"]
+
+        h = np_conv(x, w, b, pad=1)
+        h = ((h - mean[None, :, None, None])
+             / np.sqrt(var[None, :, None, None] + 1e-5)
+             * scale[None, :, None, None] + bias[None, :, None, None])
+        h = np.maximum(h, 0)
+        h = h.reshape(2, 4, 4, 2, 4, 2).max((3, 5))        # 2x2 maxpool
+        h = h.mean((2, 3))                                  # GAP + flatten
+        logits = h @ fc_w + fc_b
+        expected = np_softmax(logits)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_placeholder_roundtrip_and_allowlist(self):
+        mb, _ = self._build()
+        g = OnnxGraphMapper.import_model(mb)
+        assert g.placeholders == ["x"]
+        assert "Conv" in OnnxGraphMapper.supported_ops()
+
+
+class TestOnnxTransformerGolden:
+    def test_attention_block_matches_numpy(self):
+        D, T = 8, 4
+        wq, wk, wv, wo = [(R.randn(D, D) * 0.3).astype(np.float32) for _ in range(4)]
+        ln_g = (R.rand(D) + 0.5).astype(np.float32)
+        ln_b = R.randn(D).astype(np.float32)
+        w1 = (R.randn(D, 16) * 0.3).astype(np.float32)
+        w2 = (R.randn(16, D) * 0.3).astype(np.float32)
+        scale = np.float32(np.sqrt(D))
+
+        nodes = [
+            node("MatMul", ["x", "wq"], ["q"]),
+            node("MatMul", ["x", "wk"], ["k"]),
+            node("MatMul", ["x", "wv"], ["v"]),
+            node("Transpose", ["k"], ["kT"], a_ints("perm", [0, 2, 1])),
+            node("MatMul", ["q", "kT"], ["scores"]),
+            node("Div", ["scores", "sqrt_d"], ["scaled"]),
+            node("Softmax", ["scaled"], ["probs"], a_int("axis", -1)),
+            node("MatMul", ["probs", "v"], ["ctx"]),
+            node("MatMul", ["ctx", "wo"], ["proj"]),
+            node("Add", ["x", "proj"], ["res"]),
+            node("LayerNormalization", ["res", "ln_g", "ln_b"], ["ln"],
+                 a_float("epsilon", 1e-5), a_int("axis", -1)),
+            node("MatMul", ["ln", "w1"], ["m1"]),
+            node("Gelu", ["m1"], ["gelu"]),
+            node("MatMul", ["gelu", "w2"], ["m2"]),
+            node("Add", ["ln", "m2"], ["out"]),
+        ]
+        inits = [t_proto("wq", wq), t_proto("wk", wk), t_proto("wv", wv),
+                 t_proto("wo", wo), t_proto("ln_g", ln_g), t_proto("ln_b", ln_b),
+                 t_proto("w1", w1), t_proto("w2", w2),
+                 t_proto("sqrt_d", scale.reshape(()))]
+        mb = model(nodes, inits, [value_info("x", (1, T, D))], ["out"])
+        g = OnnxGraphMapper.import_model(mb)
+
+        x = (R.randn(1, T, D) * 0.5).astype(np.float32)
+        got = g.output({"x": x})["out"]
+
+        q, k, v = x @ wq, x @ wk, x @ wv
+        probs = np_softmax(q @ k.transpose(0, 2, 1) / scale)
+        res = x + probs @ v @ wo
+        mu = res.mean(-1, keepdims=True)
+        ln = (res - mu) / np.sqrt(res.var(-1, keepdims=True) + 1e-5) * ln_g + ln_b
+        import math
+        m1 = ln @ w1
+        gelu = 0.5 * m1 * (1 + np.vectorize(math.erf)(m1 / np.sqrt(2)))
+        expected = ln + gelu @ w2
+        np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+class TestOnnxFoldingAndErrors:
+    def test_shape_arithmetic_folds_statically(self):
+        """Shape → Slice → Concat → Reshape collapses at import (the
+        tf_import constant-folding contract, same walker design)."""
+        nodes = [
+            node("Shape", ["x"], ["sh"]),
+            node("Slice", ["sh", "starts", "ends"], ["lead"]),
+            node("Concat", ["lead", "minus1"], ["tgt"], a_int("axis", 0)),
+            node("Reshape", ["x", "tgt"], ["out"]),
+        ]
+        inits = [t_proto("starts", np.array([0], np.int64)),
+                 t_proto("ends", np.array([1], np.int64)),
+                 t_proto("minus1", np.array([-1], np.int64))]
+        mb = model(nodes, inits, [value_info("x", (2, 3, 4))], ["out"])
+        g = OnnxGraphMapper.import_model(mb)
+        x = R.randn(2, 3, 4).astype(np.float32)
+        np.testing.assert_allclose(g.output({"x": x})["out"], x.reshape(2, 12))
+
+    def test_gather_split_cast_unsqueeze(self):
+        emb = R.randn(10, 4).astype(np.float32)
+        nodes = [
+            node("Cast", ["ids_f"], ["ids"], a_int("to", 7)),
+            node("Gather", ["emb", "ids"], ["rows"], a_int("axis", 0)),
+            node("Split", ["rows"], ["a", "b"], a_int("axis", 1),
+                 a_ints("split", [2, 2])),
+            node("Unsqueeze", ["a", "axes0"], ["a3"]),
+            node("Squeeze", ["a3", "axes0"], ["a2"]),
+            node("Sub", ["a2", "b"], ["out"]),
+        ]
+        inits = [t_proto("emb", emb), t_proto("axes0", np.array([0], np.int64))]
+        mb = model(nodes, inits, [value_info("ids_f", (3,))], ["out"])
+        g = OnnxGraphMapper.import_model(mb)
+        ids = np.array([1.0, 5.0, 9.0], np.float32)
+        rows = emb[[1, 5, 9]]
+        np.testing.assert_allclose(g.output({"ids_f": ids})["out"],
+                                   rows[:, :2] - rows[:, 2:], rtol=1e-5)
+
+    def test_constant_node_and_clip(self):
+        nodes = [
+            node("Constant", [], ["c"], a_tensor("value", np.array([2.0], np.float32))),
+            node("Mul", ["x", "c"], ["m"]),
+            node("Clip", ["m"], ["out"], a_float("min", -1.0), a_float("max", 1.0)),
+        ]
+        mb = model(nodes, [], [value_info("x", (3,))], ["out"])
+        g = OnnxGraphMapper.import_model(mb)
+        x = np.array([-3.0, 0.25, 3.0], np.float32)
+        np.testing.assert_allclose(g.output({"x": x})["out"], [-1.0, 0.5, 1.0])
+
+    def test_unsupported_op_lists_allowlist(self):
+        mb = model([node("LSTM", ["x"], ["y"])], [],
+                   [value_info("x", (1, 2))], ["y"])
+        with pytest.raises(OnnxImportError, match="unsupported ONNX ops: LSTM"):
+            OnnxGraphMapper.import_model(mb)
+
+    def test_depthwise_conv_group(self):
+        w = (R.randn(3, 1, 3, 3) * 0.3).astype(np.float32)
+        nodes = [node("Conv", ["x", "w"], ["out"], a_int("group", 3),
+                      a_ints("pads", [1, 1, 1, 1]), a_ints("strides", [1, 1]),
+                      a_ints("kernel_shape", [3, 3]))]
+        mb = model(nodes, [t_proto("w", w)], [value_info("x", (1, 3, 6, 6))], ["out"])
+        g = OnnxGraphMapper.import_model(mb)
+        x = R.randn(1, 3, 6, 6).astype(np.float32)
+        got = g.output({"x": x})["out"]
+        expected = np.stack([
+            np_conv(x[:, c:c + 1], w[c:c + 1], np.zeros(1, np.float32))[0, 0]
+            for c in range(3)])[None]
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+class TestR5ReviewFixes:
+    def test_negative_step_slice_reverses(self):
+        """starts=-1, ends=INT64_MIN, steps=-1 — the tf2onnx tensor-reverse
+        idiom (r5 review: positive-only clamping dropped index 0)."""
+        nodes = [node("Slice", ["x", "st", "en", "ax", "sp"], ["out"])]
+        inits = [t_proto("st", np.array([-1], np.int64)),
+                 t_proto("en", np.array([-(2 ** 63)], np.int64)),
+                 t_proto("ax", np.array([1], np.int64)),
+                 t_proto("sp", np.array([-1], np.int64))]
+        mb = model(nodes, inits, [value_info("x", (2, 4))], ["out"])
+        g = OnnxGraphMapper.import_model(mb)
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        np.testing.assert_array_equal(g.output({"x": x})["out"], x[:, ::-1])
+
+    def test_colon_in_tensor_names(self):
+        """tf2onnx keeps 'scope/op:0' names; lookups must be exact."""
+        nodes = [node("Relu", ["model/dense/BiasAdd:0"], ["model/out:0"])]
+        mb = model(nodes, [], [value_info("model/dense/BiasAdd:0", (3,))],
+                   ["model/out:0"])
+        g = OnnxGraphMapper.import_model(mb)
+        x = np.array([-1.0, 0.0, 2.0], np.float32)
+        np.testing.assert_array_equal(
+            g.output({"model/dense/BiasAdd:0": x})["model/out:0"], [0, 0, 2])
