@@ -32,6 +32,7 @@ on):
 
 from __future__ import annotations
 
+import functools
 import json
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -191,6 +192,8 @@ def _batch_shape(lcfg: dict):
 
 def _input_type_from_shape(shape) -> InputType:
     dims = [d for d in shape[1:]]
+    if len(dims) == 4:  # keras NDHWC (Conv3D) / NTHWC (ConvLSTM2D) → NCDHW
+        return InputType.convolutional3d(dims[0], dims[1], dims[2], dims[3])
     if len(dims) == 3:
         return InputType.convolutional(dims[0], dims[1], dims[2])  # keras NHWC
     if len(dims) == 2:
@@ -205,6 +208,9 @@ class _Ctx:
 
     def __init__(self):
         self.flatten_from: Optional[Tuple[int, int, int]] = None  # (h,w,c)
+        # Keras Masking(mask_value) applies to the NEXT recurrent layer:
+        # DL4J KerasMasking wraps it in MaskZeroLayer — same here
+        self.pending_mask_value: Optional[float] = None
 
 
 def _pad4(v) -> Tuple[int, int, int, int]:
@@ -341,6 +347,12 @@ def _map_layer(cls: str, cfg: dict, w: Optional[dict], ctx: _Ctx, it: InputType,
         return [layer], [p], state
     if cls in ("LSTM", "GRU", "SimpleRNN"):
         layer, lp = _rnn_inner(cls, cfg, w, n_in=it.size)
+        if ctx.pending_mask_value is not None:  # preceding Masking layer
+            from ..nn.layers_tail import MaskZeroLayer
+
+            layer = MaskZeroLayer(underlying=layer,
+                                  mask_value=ctx.pending_mask_value)
+            ctx.pending_mask_value = None
         layers = [layer]
         params = [lp]
         if not cfg.get("return_sequences", False):
@@ -366,7 +378,14 @@ def _map_layer(cls: str, cfg: dict, w: Optional[dict], ctx: _Ctx, it: InputType,
                 "ave": "average"}.get(cfg.get("merge_mode", "concat"))
         if mode is None:
             raise KerasImportError(f"merge_mode {cfg.get('merge_mode')!r} unsupported")
-        return [Bidirectional(fwd=fl, mode=mode)], [{"fwd": fp, "bwd": bp}], None
+        layer = Bidirectional(fwd=fl, mode=mode)
+        if ctx.pending_mask_value is not None:  # preceding Masking layer
+            from ..nn.layers_tail import MaskZeroLayer
+
+            layer = MaskZeroLayer(underlying=layer,
+                                  mask_value=ctx.pending_mask_value)
+            ctx.pending_mask_value = None
+        return [layer], [{"fwd": fp, "bwd": bp}], None
     if cls == "Embedding":
         layer = EmbeddingSequenceLayer(n_in=cfg["input_dim"], n_out=cfg["output_dim"])
         return [layer], [{"W": w["embeddings"]}], None
@@ -446,6 +465,236 @@ def _map_layer(cls: str, cfg: dict, w: Optional[dict], ctx: _Ctx, it: InputType,
             kernel_size=ps, stride=st,
             convolution_mode="same" if cfg.get("padding") == "same" else "truncate")
         return [layer], [None], None
+    # ------------------------------------------------ r5 mapper wave (C13)
+    if cls == "Masking":
+        # DL4J KerasMasking parity: imports to MaskZeroLayer around the next
+        # recurrent layer (zeroed sentinel steps). NOTE the upstream-matching
+        # divergence from Keras itself: Keras FREEZES rnn state at masked
+        # steps; DL4J (and this importer) zero the step input instead.
+        ctx.pending_mask_value = float(cfg.get("mask_value", 0.0))
+        return [], [], None  # consumed by the next recurrent layer
+    if cls == "ReLU":
+        mv = cfg.get("max_value")
+        ns = cfg.get("negative_slope", 0.0) or 0.0
+        th = cfg.get("threshold", 0.0) or 0.0
+        if mv is None and ns == 0.0 and th == 0.0:
+            return [ActivationLayer(activation="relu")], [None], None
+        if mv == 6.0 and ns == 0.0 and th == 0.0:
+            return [ActivationLayer(activation="relu6")], [None], None
+
+        def _full_relu(x, _mv=mv, _ns=ns, _th=th):
+            import jax.numpy as _jnp
+
+            y = _jnp.where(x >= _th, x, _ns * (x - _th))
+            return y if _mv is None else _jnp.minimum(y, _mv)
+
+        return [ActivationLayer(activation=_full_relu)], [None], None
+    if cls == "LeakyReLU":
+        alpha = cfg.get("alpha")
+        if alpha is None:
+            alpha = cfg.get("negative_slope")  # keras-3 spelling
+        alpha = 0.3 if alpha is None else float(alpha)  # 0.0 is legitimate
+        from ..nn.activations import leakyrelu as _lrelu
+
+        return [ActivationLayer(
+            activation=functools.partial(_lrelu, alpha=alpha))], [None], None
+    if cls == "ELU":
+        alpha = float(cfg.get("alpha", 1.0))
+        if alpha == 1.0:
+            return [ActivationLayer(activation="elu")], [None], None
+        import jax.numpy as _jnp
+
+        return [ActivationLayer(
+            activation=lambda x, _a=alpha: _jnp.where(
+                x >= 0, x, _a * _jnp.expm1(x)))], [None], None
+    if cls == "ThresholdedReLU":
+        theta = float(cfg.get("theta", 1.0))
+        return [ActivationLayer(
+            activation=lambda x, _t=theta: x * (x > _t))], [None], None
+    if cls == "Softmax":
+        if cfg.get("axis", -1) != -1:
+            raise KerasImportError("Softmax axis != -1 unsupported")
+        return [ActivationLayer(activation="softmax")], [None], None
+    if cls == "PReLU":
+        from ..nn.layers_ext import PReLULayer
+
+        shared = tuple(cfg.get("shared_axes") or ())
+        layer = PReLULayer(shared_axes=shared)
+        alpha = w["alpha"]
+        if it.kind == "cnn":  # keras alpha is NHWC-shaped; ours C-first
+            alpha = np.transpose(alpha, (2, 0, 1))
+        return [layer], [{"alpha": alpha}], None
+    if cls == "TimeDistributed":
+        inner = cfg["layer"]
+        if inner["class_name"] != "Dense":
+            raise KerasImportError("TimeDistributed supports Dense only "
+                                   "(the KerasTimeDistributed subset)")
+        from ..nn.layers_tail import TimeDistributed as TDLayer
+
+        icfg = inner["config"]
+        dense = DenseLayer(n_in=it.size, n_out=icfg["units"],
+                           activation=_act(icfg.get("activation")),
+                           has_bias=icfg.get("use_bias", True))
+        return [TDLayer(underlying=dense)], [_dense_params(w)], None
+    if cls == "Lambda":
+        lname = cfg.get("name", "")
+        for key in (f"Lambda:{lname}", "Lambda"):
+            if key in CUSTOM_LAYER_MAPPERS:
+                return CUSTOM_LAYER_MAPPERS[key](cfg, w, ctx, it, is_output)
+        raise KerasImportError(
+            f"Lambda layer '{lname}' needs a registered mapper: call "
+            f"register_custom_layer('Lambda:{lname}', fn) — the "
+            "KerasLambda/SameDiffLambdaLayer contract (arbitrary python "
+            "can't be deserialized from the H5 config)")
+    if cls == "Conv3D":
+        from ..nn.layers_ext import Convolution3D
+
+        layer = Convolution3D(
+            n_out=cfg["filters"], kernel_size=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1, 1))),
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True))
+        p = {"W": w["kernel"].transpose(4, 3, 0, 1, 2)}  # DHWIO→OIDHW
+        if "bias" in w:
+            p["b"] = w["bias"]
+        return [layer], [p], None
+    if cls == "Conv3DTranspose":
+        from ..nn.layers_tail import Deconvolution3D
+
+        layer = Deconvolution3D(
+            n_out=cfg["filters"], kernel_size=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1, 1))),
+            convolution_mode="same" if cfg.get("padding") == "same" else "valid",
+            activation=_act(cfg.get("activation")))
+        # keras kernel [kd,kh,kw,O,I] → IODHW
+        p = {"W": w["kernel"].transpose(4, 3, 0, 1, 2),
+             "b": w.get("bias", np.zeros(cfg["filters"], np.float32))}
+        return [layer], [p], None
+    if cls == "ConvLSTM2D":
+        from ..nn.layers_tail import ConvLSTM2D as CL2D
+
+        if cfg.get("padding") != "same":
+            raise KerasImportError("ConvLSTM2D requires padding='same'")
+        layer = CL2D(n_out=cfg["filters"],
+                     kernel_size=_pool2(cfg["kernel_size"]),
+                     activation=_act(cfg.get("activation", "tanh")),
+                     gate_activation=_act(cfg.get("recurrent_activation",
+                                                  "sigmoid")),
+                     return_sequences=cfg.get("return_sequences", False))
+        # keras kernel [kh,kw,C,4F] → [4F,C,kh,kw] (gate order i,f,c,o both)
+        p = {"Wx": w["kernel"].transpose(3, 2, 0, 1),
+             "Wh": w["recurrent_kernel"].transpose(3, 2, 0, 1),
+             "b": w.get("bias", np.zeros(4 * cfg["filters"], np.float32))}
+        if ctx.pending_mask_value is not None:  # preceding Masking layer
+            from ..nn.layers_tail import MaskZeroLayer
+
+            layer = MaskZeroLayer(underlying=layer,
+                                  mask_value=ctx.pending_mask_value)
+            ctx.pending_mask_value = None
+        return [layer], [p], None
+    if cls == "LocallyConnected2D":
+        from ..nn.layers_ext import LocallyConnected2D as LC2D
+
+        if cfg.get("padding", "valid") != "valid":
+            raise KerasImportError("LocallyConnected2D supports padding='valid'")
+        kh, kw = _pool2(cfg["kernel_size"])
+        layer = LC2D(n_out=cfg["filters"], kernel_size=(kh, kw),
+                     stride=_pool2(cfg.get("strides", (1, 1))),
+                     activation=_act(cfg.get("activation")),
+                     has_bias=cfg.get("use_bias", True))
+        kern = w["kernel"]                      # [P, kh*kw*C, F] (h,w,c order)
+        C = kern.shape[1] // (kh * kw)
+        perm = [khi * kw * C + kwi * C + ci
+                for ci in range(C) for khi in range(kh) for kwi in range(kw)]
+        p = {"W": kern[:, perm, :]}
+        if "bias" in w:
+            p["b"] = w["bias"].reshape(-1, cfg["filters"])
+        return [layer], [p], None
+    if cls == "LocallyConnected1D":
+        from ..nn.layers_tail import LocallyConnected1D as LC1D
+
+        if cfg.get("padding", "valid") != "valid":
+            raise KerasImportError("LocallyConnected1D supports padding='valid'")
+        k = cfg["kernel_size"]
+        k = k[0] if isinstance(k, (list, tuple)) else k
+        s = cfg.get("strides", 1)
+        s = s[0] if isinstance(s, (list, tuple)) else s
+        layer = LC1D(n_out=cfg["filters"], kernel_size=k, stride=s,
+                     activation=_act(cfg.get("activation")),
+                     has_bias=cfg.get("use_bias", True))
+        kern = w["kernel"]                      # [OT, k*C, F] (t,c order)
+        C = kern.shape[1] // k
+        perm = [ki * C + ci for ci in range(C) for ki in range(k)]
+        p = {"W": kern[:, perm, :]}
+        if "bias" in w:
+            p["b"] = w["bias"].reshape(-1, cfg["filters"])
+        return [layer], [p], None
+    if cls in ("GlobalMaxPooling1D", "GlobalAveragePooling1D",
+               "GlobalMaxPooling3D", "GlobalAveragePooling3D"):
+        layer = GlobalPoolingLayer(pooling_type="max" if "Max" in cls else "avg")
+        return [layer], [None], None
+    if cls == "UpSampling1D":
+        from ..nn.layers_tail import Upsampling1D
+
+        sz = cfg.get("size", 2)
+        return [Upsampling1D(size=sz[0] if isinstance(sz, (list, tuple)) else sz)], [None], None
+    if cls == "ZeroPadding1D":
+        from ..nn.layers_tail import ZeroPadding1DLayer
+
+        pv = cfg.get("padding", 1)
+        pv = (pv, pv) if isinstance(pv, int) else tuple(pv)
+        return [ZeroPadding1DLayer(padding=pv)], [None], None
+    if cls == "Cropping1D":
+        from ..nn.layers_tail import Cropping1D
+
+        cv = cfg.get("cropping", (1, 1))
+        cv = (cv, cv) if isinstance(cv, int) else tuple(cv)
+        return [Cropping1D(cropping=cv)], [None], None
+    if cls in ("UpSampling3D", "ZeroPadding3D", "Cropping3D"):
+        from ..nn.layers_tail import (Cropping3D, Upsampling3D,
+                                      ZeroPadding3DLayer)
+
+        if cls == "UpSampling3D":
+            sz = cfg.get("size", (2, 2, 2))
+            sz = (sz,) * 3 if isinstance(sz, int) else tuple(sz)
+            return [Upsampling3D(size=sz)], [None], None
+        key = "padding" if cls == "ZeroPadding3D" else "cropping"
+        v = cfg.get(key, 1)
+        if isinstance(v, int):
+            flat = (v,) * 6
+        else:
+            flat = tuple(x for pair in
+                         (((p, p) if isinstance(p, int) else tuple(p)) for p in v)
+                         for x in pair)
+        if cls == "ZeroPadding3D":
+            return [ZeroPadding3DLayer(padding=flat)], [None], None
+        return [Cropping3D(cropping=flat)], [None], None
+    if cls in ("MaxPooling3D", "AveragePooling3D"):
+        from ..nn.layers_ext import Subsampling3DLayer
+
+        ps = cfg.get("pool_size", (2, 2, 2))
+        ps = (ps,) * 3 if isinstance(ps, int) else tuple(ps)
+        st = cfg.get("strides") or ps
+        st = (st,) * 3 if isinstance(st, int) else tuple(st)
+        return [Subsampling3DLayer(
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            kernel_size=ps, stride=st,
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
+        )], [None], None
+    if cls in ("GaussianNoise", "GaussianDropout", "AlphaDropout",
+               "SpatialDropout1D", "SpatialDropout2D"):
+        from ..nn import dropout as dmod
+
+        if cls == "GaussianNoise":
+            scheme = dmod.GaussianNoise(stddev=cfg.get("stddev", 0.1))
+        elif cls == "GaussianDropout":
+            scheme = dmod.GaussianDropout(rate=cfg.get("rate", 0.5))
+        elif cls == "AlphaDropout":
+            scheme = dmod.AlphaDropout(p=1.0 - cfg.get("rate", 0.5))
+        else:
+            scheme = dmod.SpatialDropout(p=1.0 - cfg.get("rate", 0.5))
+        return [DropoutLayer(dropout=scheme)], [None], None
     raise KerasImportError(f"unsupported Keras layer {cls} "
                            f"(KerasModelImport subset — SURVEY §2.4 C13)")
 
@@ -540,6 +789,11 @@ class KerasModelImport:
                     bn_by_idx[str(idx)] = bn
                 cur = layer.output_type(cur)
                 idx += 1
+        if ctx.pending_mask_value is not None:
+            raise KerasImportError(
+                "Masking layer was not followed by a recurrent layer "
+                "(LSTM/GRU/SimpleRNN/Bidirectional/ConvLSTM2D) — the mask "
+                "has nothing to attach to (r5 review)")
         builder.set_input_type(it)
         net = MultiLayerNetwork(builder.build()).init()
         _transplant(net.params_, params_by_idx)

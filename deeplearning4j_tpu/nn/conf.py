@@ -915,7 +915,7 @@ class GlobalPoolingLayer(Layer):
         return False
 
     def output_type(self, it: InputType) -> InputType:
-        if it.kind == "cnn":
+        if it.kind in ("cnn", "cnn3d"):
             return InputType.feed_forward(it.channels)
         return InputType.feed_forward(it.size)
 

@@ -462,6 +462,113 @@ class Deconvolution3D(Layer):
         return act.get(self.activation)(z + params["b"][None, :, None, None, None])
 
 
+@dataclass
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM (Shi et al. 2015; the KerasConvLSTM2D import
+    target, SURVEY §2.4 C13). Input [B, C, T, H, W] (time at the NCDHW
+    depth slot); gates are SAME-padded convolutions over (x_t, h_{t-1})
+    fused into one 4F-channel conv each — per step, two convs on the MXU
+    inside a lax.scan. Gate order i,f,c,o (Keras convention)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    activation: str = "tanh"
+    gate_activation: str = "sigmoid"
+    return_sequences: bool = True
+
+    def output_type(self, it: InputType) -> InputType:
+        if self.return_sequences:
+            return InputType.convolutional3d(it.depth, it.height, it.width,
+                                             self.n_out)
+        return InputType.convolutional(it.height, it.width, self.n_out)
+
+    def init_params(self, key, it: InputType, dtype=jnp.float32):
+        from .weights import init_weights
+
+        c_in = self.n_in or it.channels
+        kh, kw = self.kernel_size
+        k1, k2 = jax.random.split(key)
+        return {
+            "Wx": init_weights(k1, (4 * self.n_out, c_in, kh, kw),
+                               c_in * kh * kw, self.n_out, self.weight_init, dtype),
+            "Wh": init_weights(k2, (4 * self.n_out, self.n_out, kh, kw),
+                               self.n_out * kh * kw, self.n_out,
+                               self.weight_init, dtype),
+            "b": jnp.zeros((4 * self.n_out,), dtype),
+        }
+
+    def forward(self, params, x, it, *, training, rng=None):
+        x = self._apply_dropout(x, training, rng)
+        B, C, T, H, W = x.shape
+        F = self.n_out
+        g = act.get(self.gate_activation)
+        a = act.get(self.activation)
+
+        def conv(v, w):
+            return jax.lax.conv_general_dilated(
+                v, w, window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+        def step(carry, x_t):
+            h, c = carry
+            z = (conv(x_t, params["Wx"]) + conv(h, params["Wh"])
+                 + params["b"][None, :, None, None])
+            i, f, cc, o = jnp.split(z, 4, axis=1)
+            c = g(f) * c + g(i) * a(cc)
+            h = g(o) * a(c)
+            return (h, c), h
+
+        x_t_first = jnp.moveaxis(x, 2, 0)                    # [T,B,C,H,W]
+        h0 = jnp.zeros((B, F, H, W), x.dtype)
+        (_, _), hs = jax.lax.scan(step, (h0, h0), x_t_first)
+        if self.return_sequences:
+            return jnp.moveaxis(hs, 0, 2)                    # [B,F,T,H,W]
+        return hs[-1]
+
+
+@dataclass
+class LocallyConnected1D(Layer):
+    """conf.layers.LocallyConnected1D: unshared-weight 1-D conv over
+    [B, C, T] — per-position filter banks contracted in one einsum (the
+    1-D twin of layers_ext.LocallyConnected2D)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: int = 2
+    stride: int = 1
+    has_bias: bool = True
+
+    def _out_t(self, it: InputType) -> int:
+        return (it.timeseries_length - self.kernel_size) // self.stride + 1
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, self._out_t(it))
+
+    def init_params(self, key, it: InputType, dtype=jnp.float32):
+        from .weights import init_weights
+
+        c_in = self.n_in or it.size
+        ot = self._out_t(it)
+        fan_in = c_in * self.kernel_size
+        p = {"W": init_weights(key, (ot, fan_in, self.n_out), fan_in,
+                               self.n_out, self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((ot, self.n_out), dtype)
+        return p
+
+    def forward(self, params, x, it, *, training, rng=None):
+        x = self._apply_dropout(x, training, rng)
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (self.kernel_size,), (self.stride,), "VALID",
+            dimension_numbers=("NCH", "OIH", "NCH"))   # [B, C*k, OT] C-major
+        z = jnp.einsum("bft,tfo->bto", patches, params["W"])
+        if self.has_bias:
+            z = z + params["b"]
+        z = act.get(self.activation)(z)
+        return jnp.transpose(z, (0, 2, 1))             # [B, n_out, OT]
+
+
 # DL4J also ships Keras-flavoured alias config classes with identical
 # behavior (org.deeplearning4j.nn.conf.layers.{Convolution2D,Pooling1D,
 # Pooling2D} extend ConvolutionLayer/Subsampling*Layer 1:1)
@@ -486,5 +593,6 @@ for _cls in (GravesBidirectionalLSTM, MaskLayer, MaskZeroLayer, RnnLossLayer,
              FrozenLayerWithBackprop, TimeDistributed, SpaceToDepth,
              SpaceToBatch, Cropping1D, Cropping3D, ZeroPadding1DLayer,
              ZeroPadding3DLayer, Upsampling1D, Upsampling3D, Deconvolution3D,
-             Convolution2D, Pooling1D, Pooling2D):
+             Convolution2D, Pooling1D, Pooling2D, ConvLSTM2D,
+             LocallyConnected1D):
     LAYER_REGISTRY[_cls.__name__] = _cls

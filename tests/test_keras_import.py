@@ -333,3 +333,273 @@ class TestWave2Mappers:
             _assert_matches(net, x, y, lambda a: a)
         finally:
             CUSTOM_LAYER_MAPPERS.pop("PassThrough", None)
+
+
+class TestR5MapperWave:
+    """r5 mapper wave (VERDICT r4 missing #4): advanced activations, masking,
+    TimeDistributed, the Conv3D/ConvLSTM2D family, 1-D/3-D shape layers,
+    noise/dropout schemes, LocallyConnected, Lambda hook."""
+
+    def test_advanced_activation_layers_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((6,)),
+            layers.Dense(8),
+            layers.ReLU(),
+            layers.Dense(8),
+            layers.LeakyReLU(negative_slope=0.25),
+            layers.Dense(8),
+            layers.ELU(),
+            layers.Dense(4),
+            layers.Softmax(),
+        ])
+        x = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a)
+
+    def test_prelu_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((7,)),
+            layers.Dense(5),
+            layers.PReLU(),
+            layers.Dense(3),
+        ])
+        m.layers[1].set_weights([np.random.RandomState(1).rand(5).astype(np.float32)])
+        x = np.random.RandomState(2).randn(4, 7).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a)
+
+    def test_masking_imports_as_mask_zero_layer(self, tmp_path):
+        from deeplearning4j_tpu.nn.layers_tail import MaskZeroLayer
+
+        m = keras.Sequential([
+            keras.Input((5, 3)),
+            layers.Masking(mask_value=9.0),
+            layers.LSTM(4, return_sequences=False),
+        ])
+        x = np.random.RandomState(3).randn(2, 5, 3).astype(np.float32)
+        y = m.predict(x, verbose=0)  # no sentinel steps → exact keras parity
+        net = KerasModelImport.import_sequential(_save(m, tmp_path))
+        assert any(isinstance(l, MaskZeroLayer) for l in net.conf.layers)
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 2, 1))
+
+    def test_time_distributed_dense_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((6, 4)),
+            layers.TimeDistributed(layers.Dense(5, activation="relu")),
+            layers.GlobalAveragePooling1D(),
+            layers.Dense(2),
+        ])
+        x = np.random.RandomState(4).randn(3, 6, 4).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 2, 1))
+
+    def test_conv3d_pool3d_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((6, 6, 6, 2)),
+            layers.Conv3D(4, 3, activation="relu", padding="same"),
+            layers.MaxPooling3D(2),
+            layers.GlobalAveragePooling3D(),
+            layers.Dense(3),
+        ])
+        x = np.random.RandomState(5).randn(2, 6, 6, 6, 2).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 4, 1, 2, 3))
+
+    def test_conv3d_transpose_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((3, 3, 3, 2)),
+            layers.Conv3DTranspose(3, 2, strides=2, padding="same"),
+            layers.GlobalAveragePooling3D(),
+        ])
+        x = np.random.RandomState(6).randn(2, 3, 3, 3, 2).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 4, 1, 2, 3))
+
+    def test_convlstm2d_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((4, 5, 5, 2)),   # [T, H, W, C]
+            layers.ConvLSTM2D(3, 3, padding="same", return_sequences=False),
+            layers.GlobalAveragePooling2D(),
+        ])
+        x = np.random.RandomState(7).randn(2, 4, 5, 5, 2).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 4, 1, 2, 3))
+
+    def test_shape_layers_1d_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((6, 3)),
+            layers.ZeroPadding1D(2),
+            layers.Cropping1D((1, 1)),
+            layers.UpSampling1D(2),
+            layers.GlobalAveragePooling1D(),
+            layers.Dense(2),
+        ])
+        x = np.random.RandomState(8).randn(3, 6, 3).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 2, 1))
+
+    def test_shape_layers_3d_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((4, 4, 4, 2)),
+            layers.ZeroPadding3D(1),
+            layers.Cropping3D(((1, 1), (0, 1), (1, 0))),
+            layers.UpSampling3D(2),
+            layers.GlobalMaxPooling3D(),
+        ])
+        x = np.random.RandomState(9).randn(2, 4, 4, 4, 2).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 4, 1, 2, 3))
+
+    def test_noise_layers_are_inference_identity(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((5,)),
+            layers.GaussianNoise(0.5),
+            layers.Dense(6, activation="relu"),
+            layers.GaussianDropout(0.3),
+            layers.AlphaDropout(0.2),
+            layers.Dense(3),
+        ])
+        x = np.random.RandomState(10).randn(4, 5).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a)
+
+    def test_spatial_dropout_golden(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((6, 6, 2)),
+            layers.Conv2D(3, 3, padding="same"),
+            layers.SpatialDropout2D(0.4),
+            layers.GlobalAveragePooling2D(),
+        ])
+        x = np.random.RandomState(11).randn(2, 6, 6, 2).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a.transpose(0, 3, 1, 2))
+
+    def test_lambda_requires_registered_mapper(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((4,)),
+            layers.Lambda(lambda t: t * 2.0, name="double_it"),
+            layers.Dense(2),
+        ])
+        path = _save(m, tmp_path)
+        with pytest.raises(KerasImportError, match="Lambda:double_it"):
+            KerasModelImport.import_model(path)
+        # register the mapper → import succeeds and matches
+        from deeplearning4j_tpu.modelimport.keras_import import (
+            CUSTOM_LAYER_MAPPERS, register_custom_layer)
+        from deeplearning4j_tpu.nn.conf import ActivationLayer
+
+        register_custom_layer(
+            "Lambda:double_it",
+            lambda cfg, w, ctx, it, is_out: ([ActivationLayer(
+                activation=lambda t: t * 2.0)], [None], None))
+        try:
+            x = np.random.RandomState(12).randn(3, 4).astype(np.float32)
+            y = m.predict(x, verbose=0)
+            net = KerasModelImport.import_model(path)
+            _assert_matches(net, x, y, lambda a: a)
+        finally:
+            CUSTOM_LAYER_MAPPERS.pop("Lambda:double_it", None)
+
+    # keras 3 removed ThresholdedReLU / LocallyConnected — the mappers are
+    # exercised directly against hand-built configs + numpy oracles
+    def test_thresholded_relu_mapper_direct(self):
+        from deeplearning4j_tpu.modelimport.keras_import import _Ctx, _map_layer
+        from deeplearning4j_tpu.nn.conf import InputType
+
+        layers_, params, _ = _map_layer(
+            "ThresholdedReLU", {"theta": 0.7}, None, _Ctx(),
+            InputType.feed_forward(4), False)
+        x = np.array([[-1.0, 0.5, 0.8, 2.0]], np.float32)
+        got = np.asarray(layers_[0].forward({}, x, InputType.feed_forward(4),
+                                            training=False))
+        np.testing.assert_allclose(got, [[0.0, 0.0, 0.8, 2.0]])
+
+    def test_locally_connected_mappers_direct(self):
+        from deeplearning4j_tpu.modelimport.keras_import import _Ctx, _map_layer
+        from deeplearning4j_tpu.nn.conf import InputType
+
+        rs = np.random.RandomState(13)
+        # 1D: T=5, C=2, k=2 → OT=4; keras kernel [OT, k*C, F] in (k, c) order
+        kern = rs.randn(4, 4, 3).astype(np.float32)
+        bias = rs.randn(4, 3).astype(np.float32)
+        layers_, params, _ = _map_layer(
+            "LocallyConnected1D",
+            {"filters": 3, "kernel_size": [2], "strides": [1],
+             "padding": "valid", "activation": "linear"},
+            {"kernel": kern, "bias": bias.reshape(-1)}, _Ctx(),
+            InputType.recurrent(2, 5), False)
+        x = rs.randn(1, 2, 5).astype(np.float32)   # framework [B,C,T]
+        got = np.asarray(layers_[0].forward(params[0], x,
+                                            InputType.recurrent(2, 5),
+                                            training=False))
+        expected = np.zeros((1, 3, 4), np.float32)
+        for t in range(4):
+            patch = np.stack([x[0, :, t], x[0, :, t + 1]])  # [k, C] keras order
+            expected[0, :, t] = patch.reshape(-1) @ kern[t] + bias[t]
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+        # 2D: H=W=3, C=2, k=2 → 2x2 positions; keras kernel [P, kh*kw*C, F]
+        kern2 = rs.randn(4, 8, 3).astype(np.float32)
+        layers_, params, _ = _map_layer(
+            "LocallyConnected2D",
+            {"filters": 3, "kernel_size": [2, 2], "strides": [1, 1],
+             "padding": "valid", "activation": "linear", "use_bias": False},
+            {"kernel": kern2}, _Ctx(), InputType.convolutional(3, 3, 2), False)
+        xi = rs.randn(1, 2, 3, 3).astype(np.float32)
+        got = np.asarray(layers_[0].forward(params[0], xi,
+                                            InputType.convolutional(3, 3, 2),
+                                            training=False))
+        expected = np.zeros((1, 3, 2, 2), np.float32)
+        for p, (i, j) in enumerate([(0, 0), (0, 1), (1, 0), (1, 1)]):
+            patch = xi[0, :, i:i + 2, j:j + 2]          # [C, kh, kw]
+            feat = patch.transpose(1, 2, 0).reshape(-1)  # keras (h, w, c)
+            expected[0, :, i, j] = feat @ kern2[p]
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+class TestMaskingPlacement:
+    def test_masking_wraps_bidirectional(self, tmp_path):
+        from deeplearning4j_tpu.nn.layers_tail import MaskZeroLayer
+
+        m = keras.Sequential([
+            keras.Input((5, 3)),
+            layers.Masking(mask_value=9.0),
+            layers.Bidirectional(layers.LSTM(4, return_sequences=True)),
+            layers.GlobalAveragePooling1D(),
+        ])
+        net = KerasModelImport.import_sequential(_save(m, tmp_path))
+        wrapped = [l for l in net.conf.layers if isinstance(l, MaskZeroLayer)]
+        assert len(wrapped) == 1
+        from deeplearning4j_tpu.nn.conf import Bidirectional
+        assert isinstance(wrapped[0].underlying, Bidirectional)
+
+    def test_unconsumed_masking_raises(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((5, 3)),
+            layers.Masking(mask_value=9.0),
+            layers.GlobalAveragePooling1D(),
+            layers.Dense(2),
+        ])
+        with pytest.raises(KerasImportError, match="Masking"):
+            KerasModelImport.import_sequential(_save(m, tmp_path))
+
+    def test_leaky_relu_alpha_zero_preserved(self, tmp_path):
+        m = keras.Sequential([
+            keras.Input((4,)),
+            layers.Dense(4),
+            layers.LeakyReLU(negative_slope=0.0),
+        ])
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        y = m.predict(x, verbose=0)
+        net = KerasModelImport.import_model(_save(m, tmp_path))
+        _assert_matches(net, x, y, lambda a: a)
