@@ -13,6 +13,14 @@ from .tokenization import (
     Tokenizer,
 )
 from .vocab import Huffman, VocabCache, VocabConstructor, VocabWord
+from .sequencevectors import (
+    AbstractSequenceIterator,
+    GraphWalkIterator,
+    Sequence,
+    SequenceElement,
+    SequenceIterator,
+    SequenceVectors,
+)
 from .word2vec import Word2Vec
 from .word_vectors import WordVectorSerializer
 
@@ -26,6 +34,12 @@ __all__ = [
     "VocabConstructor",
     "Huffman",
     "Word2Vec",
+    "SequenceVectors",
+    "SequenceElement",
+    "Sequence",
+    "SequenceIterator",
+    "AbstractSequenceIterator",
+    "GraphWalkIterator",
     "WordVectorSerializer",
     "BertIterator",
     "BertMaskedLMMasker",
