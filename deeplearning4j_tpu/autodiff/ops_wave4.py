@@ -1145,6 +1145,21 @@ def _print_affinity(x):
 # gate imports this map so alias and validation case stay in lockstep.
 
 CANONICAL_ALIASES = {
+    # broadcastable / comparison canonical spellings (libnd4j registers the
+    # long names; the short TF-flavoured twins were registered in wave 1)
+    "subtract": "sub",
+    "multiply": "mul",
+    "divide": "div",
+    "reversesubtract": "rsub",
+    "reversedivide": "rdiv",
+    "squaredsubtract": "squared_difference",
+    "greater": "gt",
+    "greater_equal": "gte",
+    "less": "lt",
+    "less_equal": "lte",
+    "equals": "eq",
+    "not_equals": "neq",
+    "onehot": "one_hot",
     "avgpool2d": "avg_pool2d",
     "maxpool2d": "max_pool2d",
     "avgpool3dnew": "avg_pool3d",
