@@ -27,6 +27,7 @@ _PAGE = """<!DOCTYPE html>
 <canvas id="score" width="900" height="260"></canvas>
 <h2>Update : parameter ratios (log10)</h2>
 <canvas id="ratios" width="900" height="260"></canvas>
+<h2>Per-layer drilldown</h2><div id="layers"></div>
 <script>
 function draw(cv, series, logscale){
   const ctx=cv.getContext('2d');ctx.clearRect(0,0,cv.width,cv.height);
@@ -54,6 +55,84 @@ async function tick(){
     `session ${d.session} — ${d.records} records — last score ${d.last_score}`;
   draw(document.getElementById('score'),{score:d.score},false);
   draw(document.getElementById('ratios'),d.ratios,true);
+  const keys=await (await fetch('/layers')).json();
+  const box=document.getElementById('layers');box.textContent='';
+  keys.forEach((k,i)=>{                // text nodes: keys are NOT trusted html
+    if(i)box.appendChild(document.createTextNode(' · '));
+    const a=document.createElement('a');
+    a.href='/train/layer?name='+encodeURIComponent(k);
+    a.textContent=k;box.appendChild(a);});
+}
+tick();setInterval(tick,2000);
+</script></body></html>"""
+
+
+_LAYER_PAGE = """<!DOCTYPE html>
+<html><head><title>layer drilldown</title>
+<style>
+ body{font-family:sans-serif;margin:20px;background:#fafafa}
+ h2,h3{margin:8px 0} canvas{border:1px solid #ccc;background:#fff}
+ a{color:#1565c0}
+</style></head><body>
+<a href="/train">&larr; overview</a>
+<h2 id="title">layer</h2>
+<h3>mean &plusmn; std</h3><canvas id="meanstd" width="900" height="200"></canvas>
+<h3>min / max envelope</h3><canvas id="minmax" width="900" height="200"></canvas>
+<h3>update : parameter ratio (log10)</h3>
+<canvas id="ratio" width="900" height="200"></canvas>
+<h3>parameter histogram over time (brightness = density)</h3>
+<canvas id="hist" width="900" height="220"></canvas>
+<script>
+function line(cv, iters, seriesList, colors){
+  const ctx=cv.getContext('2d');ctx.clearRect(0,0,cv.width,cv.height);
+  let ys=[];seriesList.forEach(s=>s.forEach(v=>{if(v!=null&&isFinite(v))ys.push(v);}));
+  if(!ys.length||!iters.length) return;
+  const x0=Math.min(...iters),x1=Math.max(...iters);
+  const y0=Math.min(...ys),y1=Math.max(...ys);
+  const sx=v=>40+(cv.width-60)*(v-x0)/Math.max(1e-9,x1-x0);
+  const sy=v=>cv.height-20-(cv.height-35)*(v-y0)/Math.max(1e-9,y1-y0);
+  ctx.strokeStyle='#999';ctx.strokeRect(40,15,cv.width-60,cv.height-35);
+  ctx.fillStyle='#555';ctx.fillText(y1.toPrecision(4),2,20);
+  ctx.fillText(y0.toPrecision(4),2,cv.height-20);
+  seriesList.forEach((s,i)=>{ctx.strokeStyle=colors[i];ctx.beginPath();
+    let started=false;
+    s.forEach((v,j)=>{if(v==null||!isFinite(v))return;
+      const X=sx(iters[j]),Y=sy(v);started?ctx.lineTo(X,Y):ctx.moveTo(X,Y);started=true;});
+    ctx.stroke();});
+}
+function heat(cv, h){
+  const ctx=cv.getContext('2d');ctx.clearRect(0,0,cv.width,cv.height);
+  if(!h.iters.length) {ctx.fillText('no histograms collected — '+
+    'StatsListener(collect_histograms=True)',20,40);return;}
+  const n=h.iters.length;
+  const cw=(cv.width-60)/n, span=Math.max(1e-12,h.hi-h.lo);
+  // each column realigns its OWN bin range onto the global [lo, hi] axis —
+  // early narrow distributions stay narrow on screen as ranges widen
+  const gy=v=>cv.height-20-(cv.height-35)*(v-h.lo)/span;
+  let mx=0;h.counts.forEach(c=>c.forEach(v=>{if(v>mx)mx=v;}));
+  h.counts.forEach((c,i)=>{
+    const lo=h.los[i],bw=(h.his[i]-lo)/c.length;
+    c.forEach((v,b)=>{
+      const t=Math.pow(v/Math.max(1,mx),0.5);
+      ctx.fillStyle=`rgb(${255-Math.round(215*t)},${255-Math.round(155*t)},255)`;
+      const y1=gy(lo+(b+1)*bw),y0=gy(lo+b*bw);
+      ctx.fillRect(40+i*cw,y1,Math.ceil(cw),Math.max(1,y0-y1));});});
+  ctx.strokeStyle='#999';ctx.strokeRect(40,15,cv.width-60,cv.height-35);
+  ctx.fillStyle='#555';
+  ctx.fillText((h.hi??0).toPrecision(3),2,20);
+  ctx.fillText((h.lo??0).toPrecision(3),2,cv.height-20);
+}
+async function tick(){
+  const name=new URLSearchParams(location.search).get('name');
+  document.getElementById('title').textContent=name;
+  const d=await (await fetch('/layer/data?name='+encodeURIComponent(name))).json();
+  const lo=d.mean.map((m,i)=>m-d.std[i]), hi=d.mean.map((m,i)=>m+d.std[i]);
+  line(document.getElementById('meanstd'),d.iters,[d.mean,lo,hi],
+       ['#1565c0','#90caf9','#90caf9']);
+  line(document.getElementById('minmax'),d.iters,[d.min,d.max],
+       ['#c62828','#2e7d32']);
+  line(document.getElementById('ratio'),d.iters,[d.ratio],['#6a1b9a']);
+  heat(document.getElementById('hist'),d.hist);
 }
 tick();setInterval(tick,2000);
 </script></body></html>"""
@@ -108,6 +187,55 @@ class _Handler(BaseHTTPRequestHandler):
                 "score": score,
                 "ratios": ratios,
             })
+            return
+        if self.path == "/layers":
+            recs = self.storage.records()
+            keys = sorted((recs[-1].get("params") or {}).keys()) if recs else []
+            self._json(keys)
+            return
+        if self.path.startswith("/layer/data"):
+            from urllib.parse import parse_qs, urlparse
+
+            name = (parse_qs(urlparse(self.path).query).get("name") or [""])[0]
+            recs = self.storage.records()
+            import math
+
+            iters, mean, std, mn, mx, ratio = [], [], [], [], [], []
+            h_iters, h_counts, h_los, h_his = [], [], [], []
+            h_lo = h_hi = None
+            for r in recs:
+                st = (r.get("params") or {}).get(name)
+                if st is None:
+                    continue
+                iters.append(r["iteration"])
+                mean.append(st["mean"])
+                std.append(st["std"])
+                mn.append(st["min"])
+                mx.append(st["max"])
+                rv = (r.get("update_ratios") or {}).get(name)
+                ratio.append(math.log10(rv) if rv else None)
+                h = (r.get("histograms") or {}).get(name)
+                if h is not None and not isinstance(h, dict):
+                    # pre-r5 records stored bare counts without edges: use
+                    # the record's min/max stats as the bin range
+                    h = {"counts": h, "lo": st["min"], "hi": st["max"]}
+                if h:
+                    h_iters.append(r["iteration"])
+                    h_counts.append(h["counts"])
+                    h_los.append(h["lo"])
+                    h_his.append(h["hi"])
+                    h_lo = h["lo"] if h_lo is None else min(h_lo, h["lo"])
+                    h_hi = h["hi"] if h_hi is None else max(h_hi, h["hi"])
+            self._json({"name": name, "iters": iters, "mean": mean,
+                        "std": std, "min": mn, "max": mx, "ratio": ratio,
+                        # per-record bin ranges: each column realigns onto
+                        # the global axis (ranges widen as weights spread)
+                        "hist": {"iters": h_iters, "counts": h_counts,
+                                 "los": h_los, "his": h_his,
+                                 "lo": h_lo, "hi": h_hi}})
+            return
+        if self.path.startswith("/train/layer"):
+            self._html(_LAYER_PAGE)
             return
         if self.path in ("/train/model", "/model"):
             self._html(_model_page(getattr(self.server, "model_graph", None)))

