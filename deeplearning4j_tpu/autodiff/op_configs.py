@@ -1,0 +1,293 @@
+"""Per-op config beans (VERDICT r4 partial J3 tail).
+
+Reference: ``org.nd4j.linalg.api.ops.impl.layers.convolution.config.*``
+(Conv1DConfig/Conv2DConfig/Conv3DConfig/DeConv2DConfig/DeConv3DConfig/
+Pooling2DConfig/Pooling3DConfig/LocalResponseNormalizationConfig) and the
+recurrent ``LSTMConfiguration`` — validated parameter beans the SameDiff
+op builders consume instead of loose int lists.
+
+Here each bean is a dataclass with the reference's field names
+(kH/kW/sH/sW/pH/pW/dH/dW, isSameMode …), a ``validate()`` that enforces
+the same constraints the reference's builders do, and an ``execute(…)``
+that lowers onto the op registry — so graph-building code ported from
+nd4j keeps its shape while execution stays whole-graph XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+
+from .ops_registry import OPS
+
+__all__ = [
+    "Conv1DConfig", "Conv2DConfig", "Conv3DConfig",
+    "DeConv2DConfig", "DeConv3DConfig",
+    "Pooling2DConfig", "Pooling3DConfig",
+    "LocalResponseNormalizationConfig", "LSTMConfiguration",
+]
+
+
+class OpConfigError(ValueError):
+    """Invalid bean field combination (the reference's IllegalState)."""
+
+
+def _positive(cfg, *names):
+    for n in names:
+        if getattr(cfg, n) <= 0:
+            raise OpConfigError(
+                f"{type(cfg).__name__}.{n} must be > 0, got {getattr(cfg, n)}")
+
+
+def _non_negative(cfg, *names):
+    for n in names:
+        if getattr(cfg, n) < 0:
+            raise OpConfigError(
+                f"{type(cfg).__name__}.{n} must be >= 0, got {getattr(cfg, n)}")
+
+
+class _Bean:
+    def validate(self):
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+    def _padding(self, pads):
+        # isSameMode wins over explicit pads, like the reference builders
+        return "SAME" if self.isSameMode else [(p, p) for p in pads]
+
+
+@dataclass
+class Conv2DConfig(_Bean):
+    """ref: …convolution.config.Conv2DConfig (kH,kW,sH,sW,pH,pW,dH,dW,
+    isSameMode, dataFormat). Execution layout is NCHW (the nd4j default)."""
+
+    kH: int = 1
+    kW: int = 1
+    sH: int = 1
+    sW: int = 1
+    pH: int = 0
+    pW: int = 0
+    dH: int = 1
+    dW: int = 1
+    isSameMode: bool = False
+    dataFormat: str = "NCHW"
+
+    def validate(self):
+        _positive(self, "kH", "kW", "sH", "sW", "dH", "dW")
+        _non_negative(self, "pH", "pW")
+        if self.dataFormat != "NCHW":
+            raise OpConfigError("dataFormat NCHW only (public layout; XLA "
+                                "owns physical layout per SURVEY §2.9)")
+        return self
+
+    def execute(self, x, w, b=None):
+        self.validate()
+        return OPS["conv2d"](x, w, b, stride=(self.sH, self.sW),
+                             padding=self._padding((self.pH, self.pW)),
+                             dilation=(self.dH, self.dW))
+
+
+@dataclass
+class Conv1DConfig(_Bean):
+    """ref: Conv1DConfig (k, s, p, isSameMode); NCW layout."""
+
+    k: int = 1
+    s: int = 1
+    p: int = 0
+    isSameMode: bool = False
+
+    def validate(self):
+        _positive(self, "k", "s")
+        _non_negative(self, "p")
+        return self
+
+    def execute(self, x, w, b=None):
+        self.validate()
+        return OPS["conv1d"](x, w, b, stride=self.s,
+                             padding="SAME" if self.isSameMode else [(self.p, self.p)])
+
+
+@dataclass
+class Conv3DConfig(_Bean):
+    """ref: Conv3DConfig (kD,kH,kW,sD,sH,sW,pD,pH,pW, biasUsed, isSameMode);
+    NCDHW layout."""
+
+    kD: int = 1
+    kH: int = 1
+    kW: int = 1
+    sD: int = 1
+    sH: int = 1
+    sW: int = 1
+    pD: int = 0
+    pH: int = 0
+    pW: int = 0
+    biasUsed: bool = False
+    isSameMode: bool = False
+
+    def validate(self):
+        _positive(self, "kD", "kH", "kW", "sD", "sH", "sW")
+        _non_negative(self, "pD", "pH", "pW")
+        return self
+
+    def execute(self, x, w, b=None):
+        self.validate()
+        if self.biasUsed and b is None:
+            raise OpConfigError("biasUsed=True but no bias given")
+        return OPS["conv3d"](x, w, b if self.biasUsed else None,
+                             stride=(self.sD, self.sH, self.sW),
+                             padding=self._padding((self.pD, self.pH, self.pW)))
+
+
+@dataclass
+class DeConv2DConfig(_Bean):
+    """ref: DeConv2DConfig — transpose conv, IOHW kernel."""
+
+    kH: int = 1
+    kW: int = 1
+    sH: int = 1
+    sW: int = 1
+    isSameMode: bool = True
+
+    def validate(self):
+        _positive(self, "kH", "kW", "sH", "sW")
+        return self
+
+    def execute(self, x, w):
+        self.validate()
+        return OPS["deconv2d"](x, w, stride=(self.sH, self.sW),
+                               padding="SAME" if self.isSameMode else "VALID")
+
+
+@dataclass
+class DeConv3DConfig(_Bean):
+    """ref: DeConv3DConfig — transpose conv, IODHW kernel."""
+
+    kD: int = 1
+    kH: int = 1
+    kW: int = 1
+    sD: int = 1
+    sH: int = 1
+    sW: int = 1
+    isSameMode: bool = True
+
+    def validate(self):
+        _positive(self, "kD", "kH", "kW", "sD", "sH", "sW")
+        return self
+
+    def execute(self, x, w):
+        self.validate()
+        return OPS["deconv3d"](x, w, stride=(self.sD, self.sH, self.sW),
+                               padding="SAME" if self.isSameMode else "VALID")
+
+
+@dataclass
+class Pooling2DConfig(_Bean):
+    """ref: Pooling2DConfig (kH,kW,sH,sW,pH,pW, type MAX|AVG|PNORM,
+    isSameMode, extra=pnorm p)."""
+
+    kH: int = 2
+    kW: int = 2
+    sH: int = 2
+    sW: int = 2
+    pH: int = 0
+    pW: int = 0
+    type: str = "MAX"
+    isSameMode: bool = False
+    extra: float = 2.0
+
+    _OPS = {"MAX": "max_pool2d", "AVG": "avg_pool2d", "PNORM": "pnormpool2d"}
+
+    def validate(self):
+        _positive(self, "kH", "kW", "sH", "sW")
+        _non_negative(self, "pH", "pW")
+        if self.type.upper() not in self._OPS:
+            raise OpConfigError(f"pooling type {self.type!r} not in MAX|AVG|PNORM")
+        return self
+
+    def execute(self, x):
+        self.validate()
+        pad = ("SAME" if self.isSameMode
+               else [(0, 0), (0, 0), (self.pH, self.pH), (self.pW, self.pW)])
+        kw = dict(kernel=(self.kH, self.kW), stride=(self.sH, self.sW),
+                  padding=pad)
+        if self.type.upper() == "PNORM":
+            kw["p"] = self.extra
+        return OPS[self._OPS[self.type.upper()]](x, **kw)
+
+
+@dataclass
+class Pooling3DConfig(_Bean):
+    """ref: Pooling3DConfig over NCDHW."""
+
+    kD: int = 2
+    kH: int = 2
+    kW: int = 2
+    sD: int = 2
+    sH: int = 2
+    sW: int = 2
+    type: str = "MAX"
+    isSameMode: bool = False
+
+    def validate(self):
+        _positive(self, "kD", "kH", "kW", "sD", "sH", "sW")
+        if self.type.upper() not in ("MAX", "AVG"):
+            raise OpConfigError(f"pooling type {self.type!r} not in MAX|AVG")
+        return self
+
+    def execute(self, x):
+        self.validate()
+        op = "max_pool3d" if self.type.upper() == "MAX" else "avg_pool3d"
+        return OPS[op](x, kernel=(self.kD, self.kH, self.kW),
+                       stride=(self.sD, self.sH, self.sW),
+                       padding="SAME" if self.isSameMode else "VALID")
+
+
+@dataclass
+class LocalResponseNormalizationConfig(_Bean):
+    """ref: LocalResponseNormalizationConfig (alpha, beta, bias, depth)."""
+
+    alpha: float = 1e-4
+    beta: float = 0.75
+    bias: float = 1.0
+    depth: int = 5
+
+    def validate(self):
+        _positive(self, "depth")
+        return self
+
+    def execute(self, x):
+        self.validate()
+        return OPS["lrn"](x, depth_radius=self.depth // 2, alpha=self.alpha,
+                          beta=self.beta, bias=self.bias)
+
+
+@dataclass
+class LSTMConfiguration(_Bean):
+    """ref: …impl.layers.recurrent.config.LSTMConfiguration (peepHole,
+    forgetBias, clippingCellValue — the lstmBlockCell knobs)."""
+
+    peepHole: bool = False
+    forgetBias: float = 0.0
+    clippingCellValue: float = 0.0  # 0 = no clipping, like the reference
+
+    def validate(self):
+        if self.clippingCellValue < 0:
+            raise OpConfigError("clippingCellValue must be >= 0")
+        return self
+
+    def execute_cell(self, x, h_prev, c_prev, wx, wh, b,
+                     wci=None, wcf=None, wco=None):
+        self.validate()
+        if self.peepHole and wci is None:
+            raise OpConfigError("peepHole=True requires wci/wcf/wco")
+        h, c = OPS["lstm_block_cell"](
+            x, h_prev, c_prev, wx, wh, b,
+            wci if self.peepHole else None,
+            wcf if self.peepHole else None,
+            wco if self.peepHole else None,
+            forget_bias=self.forgetBias)
+        if self.clippingCellValue > 0:
+            c = OPS["clip_by_value"](c, -self.clippingCellValue,
+                                     self.clippingCellValue)
+        return h, c

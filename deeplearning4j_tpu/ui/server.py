@@ -16,6 +16,38 @@ from typing import List, Optional
 
 from .stats import StatsStorage
 
+# ONE canvas line-plotter shared by every page (r5 review: the layer page
+# had grown a divergent copy). series: {name: [[x, y], ...]}; null/non-
+# finite points are skipped, not plotted.
+_PLOT_JS = """
+function draw(cv, series, logscale){
+  const ctx=cv.getContext('2d');ctx.clearRect(0,0,cv.width,cv.height);
+  const names=Object.keys(series); if(!names.length) return;
+  let xs=[],ys=[];
+  names.forEach(n=>{series[n].forEach(p=>{
+    if(p[1]!=null&&isFinite(p[1])){xs.push(p[0]);ys.push(p[1]);}});});
+  if(!ys.length) return;
+  const x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
+  const sx=v=>40+(cv.width-60)*(v-x0)/Math.max(1e-9,x1-x0);
+  const sy=v=>cv.height-25-(cv.height-45)*(v-y0)/Math.max(1e-9,y1-y0);
+  ctx.strokeStyle='#999';ctx.strokeRect(40,20,cv.width-60,cv.height-45);
+  ctx.fillStyle='#555';ctx.fillText(y1.toPrecision(4),2,25);
+  ctx.fillText(y0.toPrecision(4),2,cv.height-25);
+  const colors=['#1565c0','#c62828','#2e7d32','#6a1b9a','#ef6c00','#00838f'];
+  names.forEach((n,i)=>{
+    ctx.strokeStyle=colors[i%colors.length];ctx.beginPath();
+    let started=false;
+    series[n].forEach(p=>{
+      if(p[1]==null||!isFinite(p[1])){started=false;return;}
+      const X=sx(p[0]),Y=sy(p[1]);
+      started?ctx.lineTo(X,Y):ctx.moveTo(X,Y);started=true;});
+    ctx.stroke();
+    ctx.fillStyle=colors[i%colors.length];ctx.fillText(n,50+i*140,14);
+  });
+}
+function zipxy(xs, ys){return xs.map((x,i)=>[x,ys[i]]);}
+"""
+
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j_tpu — training UI</title>
 <style>
@@ -29,26 +61,7 @@ _PAGE = """<!DOCTYPE html>
 <canvas id="ratios" width="900" height="260"></canvas>
 <h2>Per-layer drilldown</h2><div id="layers"></div>
 <script>
-function draw(cv, series, logscale){
-  const ctx=cv.getContext('2d');ctx.clearRect(0,0,cv.width,cv.height);
-  const names=Object.keys(series); if(!names.length) return;
-  let xs=[],ys=[];
-  names.forEach(n=>{series[n].forEach(p=>{xs.push(p[0]);ys.push(p[1]);});});
-  ys=ys.filter(v=>isFinite(v)); if(!ys.length) return;
-  const x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
-  const sx=v=>40+(cv.width-60)*(v-x0)/Math.max(1e-9,x1-x0);
-  const sy=v=>cv.height-25-(cv.height-45)*(v-y0)/Math.max(1e-9,y1-y0);
-  ctx.strokeStyle='#999';ctx.strokeRect(40,20,cv.width-60,cv.height-45);
-  ctx.fillStyle='#555';ctx.fillText(y1.toPrecision(4),2,25);
-  ctx.fillText(y0.toPrecision(4),2,cv.height-25);
-  const colors=['#1565c0','#c62828','#2e7d32','#6a1b9a','#ef6c00','#00838f'];
-  names.forEach((n,i)=>{
-    ctx.strokeStyle=colors[i%colors.length];ctx.beginPath();
-    series[n].forEach((p,j)=>{const X=sx(p[0]),Y=sy(p[1]);j?ctx.lineTo(X,Y):ctx.moveTo(X,Y);});
-    ctx.stroke();
-    ctx.fillStyle=colors[i%colors.length];ctx.fillText(n,50+i*140,14);
-  });
-}
+__PLOT_JS__
 async function tick(){
   const r=await fetch('/data');const d=await r.json();
   document.getElementById('meta').textContent=
@@ -64,7 +77,7 @@ async function tick(){
     a.textContent=k;box.appendChild(a);});
 }
 tick();setInterval(tick,2000);
-</script></body></html>"""
+</script></body></html>""".replace("__PLOT_JS__", _PLOT_JS)
 
 
 _LAYER_PAGE = """<!DOCTYPE html>
@@ -83,23 +96,7 @@ _LAYER_PAGE = """<!DOCTYPE html>
 <h3>parameter histogram over time (brightness = density)</h3>
 <canvas id="hist" width="900" height="220"></canvas>
 <script>
-function line(cv, iters, seriesList, colors){
-  const ctx=cv.getContext('2d');ctx.clearRect(0,0,cv.width,cv.height);
-  let ys=[];seriesList.forEach(s=>s.forEach(v=>{if(v!=null&&isFinite(v))ys.push(v);}));
-  if(!ys.length||!iters.length) return;
-  const x0=Math.min(...iters),x1=Math.max(...iters);
-  const y0=Math.min(...ys),y1=Math.max(...ys);
-  const sx=v=>40+(cv.width-60)*(v-x0)/Math.max(1e-9,x1-x0);
-  const sy=v=>cv.height-20-(cv.height-35)*(v-y0)/Math.max(1e-9,y1-y0);
-  ctx.strokeStyle='#999';ctx.strokeRect(40,15,cv.width-60,cv.height-35);
-  ctx.fillStyle='#555';ctx.fillText(y1.toPrecision(4),2,20);
-  ctx.fillText(y0.toPrecision(4),2,cv.height-20);
-  seriesList.forEach((s,i)=>{ctx.strokeStyle=colors[i];ctx.beginPath();
-    let started=false;
-    s.forEach((v,j)=>{if(v==null||!isFinite(v))return;
-      const X=sx(iters[j]),Y=sy(v);started?ctx.lineTo(X,Y):ctx.moveTo(X,Y);started=true;});
-    ctx.stroke();});
-}
+__PLOT_JS__
 function heat(cv, h){
   const ctx=cv.getContext('2d');ctx.clearRect(0,0,cv.width,cv.height);
   if(!h.iters.length) {ctx.fillText('no histograms collected — '+
@@ -126,16 +123,18 @@ async function tick(){
   const name=new URLSearchParams(location.search).get('name');
   document.getElementById('title').textContent=name;
   const d=await (await fetch('/layer/data?name='+encodeURIComponent(name))).json();
-  const lo=d.mean.map((m,i)=>m-d.std[i]), hi=d.mean.map((m,i)=>m+d.std[i]);
-  line(document.getElementById('meanstd'),d.iters,[d.mean,lo,hi],
-       ['#1565c0','#90caf9','#90caf9']);
-  line(document.getElementById('minmax'),d.iters,[d.min,d.max],
-       ['#c62828','#2e7d32']);
-  line(document.getElementById('ratio'),d.iters,[d.ratio],['#6a1b9a']);
+  const pm=(m,i)=>(m==null||d.std[i]==null)?null:m;
+  draw(document.getElementById('meanstd'),{
+    mean:zipxy(d.iters,d.mean),
+    '-std':zipxy(d.iters,d.mean.map((m,i)=>pm(m,i)==null?null:m-d.std[i])),
+    '+std':zipxy(d.iters,d.mean.map((m,i)=>pm(m,i)==null?null:m+d.std[i]))});
+  draw(document.getElementById('minmax'),
+       {min:zipxy(d.iters,d.min),max:zipxy(d.iters,d.max)});
+  draw(document.getElementById('ratio'),{ratio:zipxy(d.iters,d.ratio)});
   heat(document.getElementById('hist'),d.hist);
 }
 tick();setInterval(tick,2000);
-</script></body></html>"""
+</script></body></html>""".replace("__PLOT_JS__", _PLOT_JS)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -189,9 +188,12 @@ class _Handler(BaseHTTPRequestHandler):
             })
             return
         if self.path == "/layers":
-            recs = self.storage.records()
-            keys = sorted((recs[-1].get("params") or {}).keys()) if recs else []
-            self._json(keys)
+            # union across ALL records: the newest row may lack params
+            # (remote posts, reused storage files — r5 review)
+            keys = set()
+            for r in self.storage.records():
+                keys.update((r.get("params") or {}).keys())
+            self._json(sorted(keys))
             return
         if self.path.startswith("/layer/data"):
             from urllib.parse import parse_qs, urlparse
@@ -207,13 +209,19 @@ class _Handler(BaseHTTPRequestHandler):
                 st = (r.get("params") or {}).get(name)
                 if st is None:
                     continue
+                def fin(v):
+                    # divergence writes NaN stats; the NaN token is not
+                    # strict JSON and kills browser JSON.parse (r5 review)
+                    return v if v is not None and math.isfinite(v) else None
+
                 iters.append(r["iteration"])
-                mean.append(st["mean"])
-                std.append(st["std"])
-                mn.append(st["min"])
-                mx.append(st["max"])
+                mean.append(fin(st["mean"]))
+                std.append(fin(st["std"]))
+                mn.append(fin(st["min"]))
+                mx.append(fin(st["max"]))
                 rv = (r.get("update_ratios") or {}).get(name)
-                ratio.append(math.log10(rv) if rv else None)
+                ratio.append(fin(math.log10(rv)) if rv and math.isfinite(rv)
+                             else None)
                 h = (r.get("histograms") or {}).get(name)
                 if h is not None and not isinstance(h, dict):
                     # pre-r5 records stored bare counts without edges: use

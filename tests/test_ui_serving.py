@@ -286,3 +286,32 @@ def test_layer_data_tolerates_pre_r5_histogram_lists():
         assert d["hist"]["los"] == [-2.0] and d["hist"]["his"] == [2.0]
     finally:
         server.stop()
+
+
+def test_layer_data_sanitizes_nonfinite_and_unions_layers():
+    """Divergence writes NaN stats; /layer/data must emit strict JSON
+    (null, not the NaN token) and /layers must union across records
+    (r5 review findings)."""
+    storage = InMemoryStatsStorage()
+    storage.put_record({
+        "session": "s", "iteration": 0, "epoch": 0, "time": 0.0, "score": 1.0,
+        "params": {"0/W": {"mean": float("nan"), "std": float("inf"),
+                           "min": -1.0, "max": 1.0}},
+        "update_ratios": {"0/W": float("nan")},
+    })
+    storage.put_record({"session": "s", "iteration": 1, "epoch": 0,
+                        "time": 1.0, "score": 2.0})  # no params at all
+    server = UIServer(port=0)
+    server.attach(storage)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/layers", timeout=10) as r:
+            assert json.loads(r.read()) == ["0/W"]  # union, not last record
+        with urllib.request.urlopen(base + "/layer/data?name=0/W", timeout=10) as r:
+            raw = r.read().decode()
+        assert "NaN" not in raw and "Infinity" not in raw
+        d = json.loads(raw)
+        assert d["mean"] == [None] and d["std"] == [None]
+        assert d["ratio"] == [None] and d["min"] == [-1.0]
+    finally:
+        server.stop()
