@@ -440,27 +440,67 @@ def bench_bert(p):
     rng = jax.random.key(1)
     it = jnp.asarray(0, jnp.int32)
 
-    def timed(b):
-        nonlocal params, opt
+    def timed(run, b):
+        """ONE measurement protocol for all three variants: warmup runs,
+        true-sync, timed window, true-sync. ``run(b)`` advances its own
+        captured state and returns the step loss."""
         for _ in range(p["warmup"]):
-            params, opt, loss = step(params, opt, b, it, rng)
+            loss = run(b)
         float(loss)
         t0 = time.perf_counter()
         for _ in range(p["steps"]):
-            params, opt, loss = step(params, opt, b, it, rng)
+            loss = run(b)
         float(loss)
         return time.perf_counter() - t0
 
-    dt = timed(batch)
+    state = {"params": params, "opt": opt}
+    del params, opt  # donated into the step from here on — no other refs
+
+    def run_mlm(b):
+        state["params"], state["opt"], loss = step(state["params"],
+                                                   state["opt"], b, it, rng)
+        return loss
+
+    dt = timed(run_mlm, batch)
     # masked variant: padding mask present → the Pallas masked-flash path
     # (r4 silently fell back to the O(T^2) dense path under any mask)
     pad = np.ones((B, T), np.float32)
     pad[:, int(T * 0.9):] = 0.0
-    dt_masked = timed({**batch, "pad_mask": jnp.asarray(pad)})
+    dt_masked = timed(run_mlm, {**batch, "pad_mask": jnp.asarray(pad)})
+
+    # SQuAD fine-tune variant — BASELINE configs[4] names the fine-tune
+    # workload specifically ("BERT-base fine-tune via SameDiff TF-import
+    # (SQuAD)"): span head over the full encoder, masked batch
+    from deeplearning4j_tpu.models.transformer import (
+        init_qa_head, make_qa_train_step)
+
+    qa_step = jax.jit(make_qa_train_step(cfg, updater),
+                      donate_argnums=(0, 1, 2, 3))
+    qa_batch = {
+        "tokens": batch["tokens"],
+        "segments": jnp.asarray((np.arange(T)[None] >= T // 4)
+                                .repeat(B, 0).astype(np.int32)),
+        "pad_mask": jnp.asarray(pad),
+        "start_positions": jnp.asarray(rs.randint(0, T, B), jnp.int32),
+        "end_positions": jnp.asarray(rs.randint(0, T, B), jnp.int32),
+    }
+    # the MLM-trained encoder + its opt state move into the QA step (their
+    # buffers get donated there; `state` is emptied to make that explicit)
+    qa_params = init_qa_head(jax.random.key(2), cfg)
+    qs = {"p": state.pop("params"), "qa": qa_params,
+          "o": state.pop("opt"), "qo": updater.init(qa_params)}
+
+    def run_qa(b):
+        qs["p"], qs["qa"], qs["o"], qs["qo"], loss = qa_step(
+            qs["p"], qs["qa"], qs["o"], qs["qo"], b, it, rng)
+        return loss
+
+    dt_squad = timed(run_qa, qa_batch)
     return {"metric": "bert_mlm_tokens_per_sec",
             "value": round(B * T * p["steps"] / dt, 1), "unit": "tokens/sec/chip",
             "batch": B, "seq": T, "mlm_positions": P,
             "masked_tokens_per_sec": round(B * T * p["steps"] / dt_masked, 1),
+            "squad_finetune_tokens_per_sec": round(B * T * p["steps"] / dt_squad, 1),
             "model": "tiny" if p["tiny"] else "bert-base"}
 
 

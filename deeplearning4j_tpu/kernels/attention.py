@@ -416,8 +416,8 @@ def _as_key_mask(mask):
 
 
 def flash_attention(q, k, v, mask=None, *, segment_ids=None, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+                    scale: Optional[float] = None, block_q: Optional[int] = None,
+                    block_k: Optional[int] = None, interpret: Optional[bool] = None):
     """Pallas flash attention, O(T) memory in BOTH directions (blockwise
     online softmax forward; FlashAttention-2 blockwise backward).
 
@@ -427,7 +427,9 @@ def flash_attention(q, k, v, mask=None, *, segment_ids=None, causal: bool = Fals
     (packed-sequence / A-B isolation). Both compose: padded keys are forced
     to id -1. Sequence lengths need NOT be multiples of the block size — a
     pad shim rounds them up and masks the padding out (VERDICT r4 weak #2:
-    no more silent fallback for masked or odd-length batches).
+    no more silent fallback for masked or odd-length batches). Block sizes
+    default to 128², scaling to (512, 1024) at T ≥ 4096 (measured long-T
+    sweet spot on v5e; SURVEY §5.7 long-context mandate).
 
     Differentiable via custom_vjp: the forward kernel emits the per-row
     logsumexp; the backward kernels recompute each [bq,bk] prob block in VMEM
@@ -444,6 +446,14 @@ def flash_attention(q, k, v, mask=None, *, segment_ids=None, causal: bool = Fals
         scale = 1.0 / math.sqrt(D)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None or block_k is None:
+        # long sequences want coarse tiles: the grid runs sequentially per
+        # core, and at T=8k (512, 1024) blocks measured 3.6x faster than
+        # the 128-block default fwd+bwd on v5e (r5, BASELINE.md) — also
+        # beating the dense path, which OOMs by T=16k anyway
+        long_t = min(Tq, Tk) >= 4096
+        block_q = block_q or (512 if long_t else 128)
+        block_k = block_k or (1024 if long_t else 128)
 
     qseg = kseg = None
     if segment_ids is not None:

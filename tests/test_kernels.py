@@ -262,3 +262,13 @@ def test_ulysses_heads_divisibility_error():
     )
     with pytest.raises(ValueError, match="divisible"):
         f(q, k, v)
+
+
+def test_flash_long_t_auto_blocks_match_reference():
+    """T >= 4096 auto-selects (512, 1024) blocks (the measured long-T sweet
+    spot); numerics must match the dense reference under a mask."""
+    q, k, v = _qkv((1, 2, 4096, 16))
+    mask = jnp.ones((1, 4096)).at[:, 3700:].set(0.0)
+    out = flash_attention(q, k, v, mask, interpret=True)
+    ref = mha_reference(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
