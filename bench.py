@@ -538,6 +538,15 @@ BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
 def main():
     import jax
 
+    from deeplearning4j_tpu.monitoring import (DeviceMemoryWatchdog,
+                                               RecompileWatchdog, get_registry)
+
+    # telemetry riding along with every bench run: XLA compile count/seconds
+    # (recompile storms show up as a compile counter out of proportion to the
+    # config count) + device-memory high-water per window
+    recompile_wd = RecompileWatchdog().install()
+    memory_wd = DeviceMemoryWatchdog()
+
     backend = jax.default_backend()
     params = _scale(backend == "tpu")
     only = sys.argv[1] if len(sys.argv) > 1 else None
@@ -555,6 +564,7 @@ def main():
         results[name] = BENCHES[name](params[name])
         if cal is not None:
             results[name]["calibration"] = cal
+        memory_wd.sample()  # high-water gauge tracks the max across configs
 
     from deeplearning4j_tpu.common.precision import compute_dtype
 
@@ -571,7 +581,13 @@ def main():
         "backend": backend,
         "matmul_precision": effective_precision,
         "configs": results,
+        # full registry snapshot: compile counters, memory watermarks, and
+        # whatever metrics the exercised code paths emitted — BENCH files
+        # carry telemetry from here on
+        "telemetry": {"compiles": recompile_wd.stats(),
+                      "metrics": get_registry().snapshot()},
     }
+    recompile_wd.close()
     print(json.dumps(out))
 
 

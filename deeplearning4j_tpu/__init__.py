@@ -21,6 +21,8 @@ Top-level namespaces (reference equivalents in brackets):
 - ``kernels``  — Pallas kernels (flash/ring attention, …)   [libnd4j helpers/cuda]
 - ``eval``     — Evaluation/ROC/Regression                  [org.nd4j.evaluation]
 - ``nlp``      — tokenizers, Word2Vec, BERT pipeline        [deeplearning4j-nlp]
+- ``monitoring`` — metrics registry, trace spans, watchdogs [StatsListener/OpProfiler,
+                                                             exceeded: /metrics endpoint]
 """
 
 __version__ = "0.1.0"
@@ -43,6 +45,7 @@ _SUBMODULES = (
     "nlp",
     "rng",
     "listeners",
+    "monitoring",
     "serde",
     "utils",
     "common",

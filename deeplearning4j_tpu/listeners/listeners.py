@@ -23,6 +23,31 @@ from typing import List, Optional, Tuple
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
+_console_handler = None
+
+
+def _ensure_console_handler() -> None:
+    """Console-feedback listeners must be visible out of the box (the
+    reference prints via slf4j-simple by default). If the application
+    configured logging — any handler on this logger or the root — respect
+    it; otherwise attach a plain stderr handler. If the app configures root
+    logging later, the next listener construction removes ours again (no
+    duplicate lines). An explicitly-set logger level is never overridden."""
+    global _console_handler
+    root_configured = bool(logging.getLogger().handlers)
+    if _console_handler is not None and root_configured:
+        logger.removeHandler(_console_handler)
+        _console_handler = None
+        return
+    if logger.handlers or root_configured:
+        return
+    _console_handler = logging.StreamHandler()
+    _console_handler.setFormatter(logging.Formatter("%(message)s"))
+    logger.addHandler(_console_handler)
+    if logger.level == logging.NOTSET:  # respect an explicit user level
+        logger.setLevel(logging.INFO)
+
+
 class TrainingListener:
     def iteration_done(self, model, iteration: int, epoch: int) -> None:
         pass
@@ -35,27 +60,41 @@ class TrainingListener:
 
 
 class ScoreIterationListener(TrainingListener):
-    """Print score every N iterations (ScoreIterationListener)."""
+    """Log score every N iterations (ScoreIterationListener)."""
 
     def __init__(self, print_iterations: int = 10):
+        _ensure_console_handler()
         self.n = max(1, print_iterations)
 
     def iteration_done(self, model, iteration, epoch):
         if iteration % self.n == 0:
+            # score() forces a device sync (lazy score_) — read it ONCE
             logger.info("Score at iteration %d is %.6f", iteration, model.score())
-            print(f"Score at iteration {iteration} is {model.score():.6f}")
 
 
 class PerformanceListener(TrainingListener):
     """Throughput reporting (PerformanceListener: samples/sec, batches/sec,
-    iteration time). GC stats are meaningless here; reports host RSS instead."""
+    iteration time). GC stats are meaningless here; reports host RSS instead
+    (``resource.getrusage`` current/peak) and mirrors both throughput and RSS
+    into the monitoring registry as gauges."""
 
-    def __init__(self, frequency: int = 10, report_samples: bool = True):
+    def __init__(self, frequency: int = 10, report_samples: bool = True,
+                 registry=None):
+        _ensure_console_handler()
         self.frequency = max(1, frequency)
         self.report_samples = report_samples
         self._last_time = None
         self._last_iter = None
         self.last_samples_per_sec = float("nan")
+        self.last_rss_bytes = 0
+        from ..monitoring.registry import get_registry
+
+        r = registry or get_registry()
+        self._rss_gauge = r.gauge(
+            "tdl_host_rss_bytes", "Host resident set size (PerformanceListener)")
+        self._sps_gauge = r.gauge(
+            "tdl_listener_samples_per_sec",
+            "Throughput as observed by PerformanceListener")
 
     def iteration_done(self, model, iteration, epoch):
         now = time.perf_counter()
@@ -67,26 +106,40 @@ class PerformanceListener(TrainingListener):
             msg = f"iteration {iteration}: {ips:.1f} iters/sec"
             if batch:
                 self.last_samples_per_sec = ips * batch
+                self._sps_gauge.set(self.last_samples_per_sec)
                 msg += f", {self.last_samples_per_sec:.1f} samples/sec"
-            print(msg)
+            from ..monitoring.watchdogs import host_rss_bytes
+
+            self.last_rss_bytes = host_rss_bytes()
+            self._rss_gauge.set(self.last_rss_bytes)
+            msg += f", host RSS {self.last_rss_bytes / 1e6:.1f} MB"
+            logger.info("%s", msg)
             self._last_time, self._last_iter = now, iteration
         elif self._last_time is None:
             self._last_time, self._last_iter = now, iteration
 
 
 class TimeIterationListener(TrainingListener):
-    """ETA printing (TimeIterationListener)."""
+    """ETA logging (TimeIterationListener)."""
 
     def __init__(self, total_iterations: int, frequency: int = 50):
+        _ensure_console_handler()
         self.total = total_iterations
-        self.frequency = frequency
-        self._start = time.perf_counter()
+        self.frequency = max(1, frequency)  # clamp like the other listeners
+        # clock starts on the FIRST iteration, not at construction — a
+        # listener built long before fit() would skew every ETA
+        self._start = None
+        self._first_iter = None
 
     def iteration_done(self, model, iteration, epoch):
-        if iteration % self.frequency == 0 and iteration > 0:
+        if self._start is None:
+            self._start, self._first_iter = time.perf_counter(), iteration
+        if iteration % self.frequency == 0 and iteration > self._first_iter:
             elapsed = time.perf_counter() - self._start
-            remaining = elapsed / iteration * (self.total - iteration)
-            print(f"iteration {iteration}/{self.total}, ETA {remaining:.0f}s")
+            done = iteration - self._first_iter
+            remaining = elapsed / done * (self.total - iteration)
+            logger.info("iteration %d/%d, ETA %.0fs",
+                        iteration, self.total, remaining)
 
 
 class CollectScoresIterationListener(TrainingListener):
@@ -146,6 +199,7 @@ class EvaluativeListener(TrainingListener):
     """Periodic held-out evaluation (EvaluativeListener)."""
 
     def __init__(self, iterator, frequency_epochs: int = 1):
+        _ensure_console_handler()
         self.iterator = iterator
         self.frequency = max(1, frequency_epochs)
         self.history: List[float] = []
@@ -154,4 +208,5 @@ class EvaluativeListener(TrainingListener):
         if model.epoch % self.frequency == 0:
             ev = model.evaluate(self.iterator)
             self.history.append(ev.accuracy())
-            print(f"epoch {model.epoch}: eval accuracy {ev.accuracy():.4f}")
+            logger.info("epoch %d: eval accuracy %.4f",
+                        model.epoch, ev.accuracy())

@@ -1,0 +1,40 @@
+"""Unified telemetry subsystem (SURVEY §2.4 C14 / §5.1 observability tier).
+
+- :mod:`.registry` — labeled counters / gauges / fixed-bucket histograms with
+  Prometheus text exposition (served by ``UIServer`` at ``/metrics``) and a
+  JSON snapshot (``/metrics.json``, ``bench.py`` telemetry block);
+- :mod:`.trace` — nestable host spans aligned with XProf device traces,
+  feeding ``OpProfiler`` chrome-trace files;
+- :mod:`.watchdogs` — device-memory watermark sampler + XLA recompile /
+  shape-churn detector;
+- :mod:`.listener` — ``MetricsListener``, the TrainingListener bridge that
+  wires a network's fit loop into the registry.
+"""
+
+from .listener import MetricsListener
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       get_registry)
+from .trace import Span, current_span_path, set_trace_profiler, span, step_span
+from .watchdogs import (DeviceMemoryWatchdog, RecompileWatchdog, active,
+                        host_rss_bytes, note_signature, note_step,
+                        signature_of)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "MetricsListener",
+    "Span",
+    "span",
+    "step_span",
+    "current_span_path",
+    "set_trace_profiler",
+    "DeviceMemoryWatchdog",
+    "RecompileWatchdog",
+    "host_rss_bytes",
+    "note_signature",
+    "note_step",
+    "signature_of",
+]

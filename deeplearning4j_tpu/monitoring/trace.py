@@ -1,0 +1,106 @@
+"""Nestable host-side spans aligned with the XProf device timeline.
+
+Reference: SameDiff's ``ProfilingListener`` emits host-side chrome-trace
+events; XProf/XPlane owns the device timeline (SURVEY §5.1). The two views
+were previously uncorrelated. A :func:`span` does three things at once:
+
+- wraps ``jax.profiler.TraceAnnotation`` (or ``StepTraceAnnotation`` when a
+  ``step_num`` is given) so the span shows up on the device trace whenever an
+  XProf capture is active — host spans and HLO timelines line up by name;
+- records a chrome-trace complete event into an :class:`~..ops.profiler.
+  OpProfiler` (the one attached via :func:`set_trace_profiler`, or an
+  explicit ``profiler=``), so ONE ``to_chrome_trace`` file carries both op
+  events and span events;
+- optionally observes the span duration into a registry histogram.
+
+Spans nest: names are qualified with the enclosing span path
+(``fit/step/h2d``), per thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+_tls = threading.local()
+
+_trace_profiler = None  # OpProfiler every span also records into (optional)
+
+
+def set_trace_profiler(profiler) -> None:
+    """Attach an ``OpProfiler`` that every span records into (give it
+    ``ProfilerConfig(trace_events=True)`` to capture the events). Pass
+    ``None`` to detach."""
+    global _trace_profiler
+    _trace_profiler = profiler
+
+
+def get_trace_profiler():
+    return _trace_profiler
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_span_path() -> str:
+    """Qualified name of the innermost active span ('' outside any span)."""
+    return "/".join(_stack())
+
+
+class Span:
+    def __init__(self, name: str, profiler=None, histogram=None,
+                 step_num: Optional[int] = None):
+        self.name = name
+        self._profiler = profiler
+        self._histogram = histogram
+        self._step_num = step_num
+        self._annotation = None
+        self.qualified_name: Optional[str] = None
+        self.duration_s: Optional[float] = None
+
+    def __enter__(self):
+        import jax
+
+        stack = _stack()
+        stack.append(self.name)
+        self.qualified_name = "/".join(stack)
+        # StepTraceAnnotation marks step boundaries for XProf's step-time
+        # analysis; TraceAnnotation is a plain named region
+        if self._step_num is not None:
+            self._annotation = jax.profiler.StepTraceAnnotation(
+                self.name, step_num=self._step_num)
+        else:
+            self._annotation = jax.profiler.TraceAnnotation(self.name)
+        self._annotation.__enter__()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur_ns = time.perf_counter_ns() - self._t0
+        self._annotation.__exit__(*exc)
+        _stack().pop()
+        self.duration_s = dur_ns / 1e9
+        prof = self._profiler if self._profiler is not None else _trace_profiler
+        if prof is not None:
+            prof.record(self.qualified_name, dur_ns)
+        if self._histogram is not None:
+            self._histogram.observe(self.duration_s)
+        return False
+
+
+def span(name: str, profiler=None, histogram=None) -> Span:
+    """Open a nestable host span: ``with span("h2d"): ...``"""
+    return Span(name, profiler=profiler, histogram=histogram)
+
+
+def step_span(step_num: int, name: str = "train",
+              profiler=None, histogram=None) -> Span:
+    """A span marking ONE training step (XProf StepTraceAnnotation), so the
+    device trace's step-time view and the host cadence agree on boundaries."""
+    return Span(name, profiler=profiler, histogram=histogram,
+                step_num=step_num)

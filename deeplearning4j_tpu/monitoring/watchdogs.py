@@ -1,0 +1,355 @@
+"""Device-memory and XLA-recompilation watchdogs.
+
+Two failure modes dominate real TPU training and are invisible in the
+reference's listener stack:
+
+- **HBM creep / OOM**: XLA owns device memory; by the time an allocation
+  fails the job is dead. :class:`DeviceMemoryWatchdog` samples
+  ``device.memory_stats()`` into in-use / high-water gauges (host-RSS
+  fallback on backends that expose no stats, e.g. CPU smoke runs) and can
+  dump a live-buffer summary when a threshold is crossed — the moral
+  equivalent of ``common.debug.LiveBufferMonitor`` wired into metrics.
+
+- **silent recompilation**: a shape-churning input pipeline recompiles the
+  step executable every few minibatches and the job quietly runs 10-100x
+  slow. :class:`RecompileWatchdog` hooks ``jax.monitoring``'s
+  backend-compile event for counts + compile seconds, and correlates our
+  own per-function call signatures (noted by the fit loops) to warn when
+  the SAME function compiles ≥ N times within M steps.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Tuple
+
+from .registry import MetricsRegistry, get_registry
+
+logger = logging.getLogger("deeplearning4j_tpu.monitoring")
+
+
+def host_rss_bytes() -> int:
+    """Current resident set size of this process, in bytes."""
+    try:  # /proc gives CURRENT rss; getrusage only gives the peak
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        import resource
+        import sys
+
+        # ru_maxrss is KB on Linux but BYTES on macOS (the only platform
+        # that actually reaches this fallback — no /proc there)
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return rss if sys.platform == "darwin" else rss * 1024
+
+
+class DeviceMemoryWatchdog:
+    """Watermark sampler over ``jax.devices()`` memory stats.
+
+    ``sample()`` is explicit (cheap, host-side only); ``start(interval)``
+    runs it on a daemon thread for long jobs. The high-water gauge is OURS
+    (max over samples), so it works even on backends whose stats carry no
+    peak field — and on the host-RSS fallback.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 threshold_bytes: Optional[int] = None,
+                 dump_live_buffers: bool = False, dump_top: int = 10):
+        self.registry = registry or get_registry()
+        self.threshold_bytes = threshold_bytes
+        self.dump_live_buffers = dump_live_buffers
+        self.dump_top = dump_top
+        r = self.registry
+        self._in_use = r.gauge(
+            "tdl_device_memory_bytes_in_use",
+            "Device memory currently allocated (host RSS on statless backends)",
+            labels=("device",))
+        self._high_water = r.gauge(
+            "tdl_device_memory_high_water_bytes",
+            "High-water mark of device memory in use since watchdog creation",
+            labels=("device",))
+        self._limit = r.gauge(
+            "tdl_device_memory_limit_bytes",
+            "Device memory capacity where the backend reports it",
+            labels=("device",))
+        self._exceeded = r.counter(
+            "tdl_device_memory_threshold_exceeded_total",
+            "Samples that found memory in use above the configured threshold")
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def sample(self) -> Dict[str, int]:
+        """One sampling pass; returns {device_label: bytes_in_use}."""
+        import jax
+
+        out: Dict[str, int] = {}
+        saw_stats = False
+        for d in jax.devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:  # backend without the API at all
+                stats = None
+            if not stats:
+                continue
+            saw_stats = True
+            label = f"{d.platform}:{d.id}"
+            in_use = int(stats.get("bytes_in_use", 0))
+            out[label] = in_use
+            self._in_use.labels(label).set(in_use)
+            self._high_water.labels(label).set_to_max(
+                max(in_use, int(stats.get("peak_bytes_in_use", 0))))
+            limit = stats.get("bytes_limit")
+            if limit:
+                self._limit.labels(label).set(int(limit))
+        if not saw_stats:
+            # CPU (and some tunnel) backends expose no per-device stats;
+            # host RSS is the best available proxy for the smoke tier
+            rss = host_rss_bytes()
+            out["host"] = rss
+            self._in_use.labels("host").set(rss)
+            self._high_water.labels("host").set_to_max(rss)
+        self._check_threshold(out)
+        return out
+
+    def _check_threshold(self, sampled: Dict[str, int]) -> None:
+        if self.threshold_bytes is None:
+            return
+        over = {k: v for k, v in sampled.items() if v > self.threshold_bytes}
+        if not over:
+            return
+        self._exceeded.inc()
+        worst = max(over, key=over.get)
+        logger.warning(
+            "device memory watchdog: %s at %.1f MB exceeds threshold %.1f MB",
+            worst, over[worst] / 1e6, self.threshold_bytes / 1e6)
+        if self.dump_live_buffers:
+            for line in self.live_buffer_summary(self.dump_top):
+                logger.warning("  %s", line)
+
+    def live_buffer_summary(self, top: int = 10) -> List[str]:
+        """Largest live device buffers grouped by (shape, dtype) — the
+        'what is actually holding HBM' dump."""
+        import jax
+
+        groups: Dict[Tuple[str, str], List[int]] = defaultdict(list)
+        for a in jax.live_arrays():
+            try:
+                groups[(str(a.shape), str(a.dtype))].append(a.nbytes)
+            except Exception:
+                continue
+        rows = sorted(((sum(v), len(v), k) for k, v in groups.items()),
+                      reverse=True)[:top]
+        return [f"{total / 1e6:9.2f} MB x{count:<5} {shape} {dtype}"
+                for total, count, (shape, dtype) in rows]
+
+    # -- background sampling ----------------------------------------------
+
+    def start(self, interval_s: float = 10.0) -> "DeviceMemoryWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.sample()
+                except Exception:  # sampling must never kill the job
+                    logger.exception("device memory watchdog sample failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="tdl-memory-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+# --------------------------------------------------------------- recompiles
+
+# jax.monitoring listeners are append-only (no unregister), so ONE module
+# hook is installed lazily and fans out to whatever watchdogs are active.
+_ACTIVE: List["RecompileWatchdog"] = []
+_HOOK_LOCK = threading.Lock()
+_HOOK_INSTALLED = False
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _install_hook() -> None:
+    global _HOOK_INSTALLED
+    with _HOOK_LOCK:
+        if _HOOK_INSTALLED:
+            return
+        import jax
+
+        def on_duration(event: str, duration: float, **kw) -> None:
+            if event == _COMPILE_EVENT:
+                for wd in list(_ACTIVE):
+                    wd._on_compile(duration)
+
+        jax.monitoring.register_event_duration_secs_listener(on_duration)
+        _HOOK_INSTALLED = True
+
+
+def active() -> bool:
+    """True when at least one RecompileWatchdog is installed — instrumented
+    call sites guard signature computation behind this (zero-cost when off)."""
+    return bool(_ACTIVE)
+
+
+def note_step() -> None:
+    """Advance every active watchdog's step clock (called by the fit
+    loops / MetricsListener once per training iteration)."""
+    for wd in list(_ACTIVE):
+        wd.step()
+
+
+def note_signature(fn_name: str, signature) -> None:
+    """Record a call signature for ``fn_name`` (called by the fit loops
+    with the minibatch shape/dtype signature). No-op with no active
+    watchdog."""
+    if not _ACTIVE:
+        return
+    for wd in list(_ACTIVE):
+        wd.note_signature(fn_name, signature)
+
+
+def signature_of(*trees) -> Tuple:
+    """Hashable (shape, dtype) signature of arbitrary pytrees of arrays —
+    what jit keys its executable cache on, minus weak types."""
+    import jax
+
+    sig = []
+    for leaf in jax.tree.leaves(trees):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            sig.append(repr(leaf))
+        else:
+            sig.append((tuple(shape), str(getattr(leaf, "dtype", "?"))))
+    return tuple(sig)
+
+
+class RecompileWatchdog:
+    """Counts XLA compiles / compile seconds and warns on shape-churn.
+
+    Two correlated signals:
+
+    - every backend compile (via ``jax.monitoring``) increments
+      ``tdl_xla_compiles_total`` and adds to
+      ``tdl_xla_compile_seconds_total``;
+    - fit loops note their step-input signatures; when the same function
+      accumulates ≥ ``churn_threshold`` distinct signatures within
+      ``window_steps`` steps, a warning is logged and
+      ``tdl_shape_churn_warnings_total`` increments.
+
+    Use as a context manager (or ``install()``/``close()``); inactive
+    instances cost nothing on the hot path.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 window_steps: int = 50, churn_threshold: int = 3):
+        self.registry = registry or get_registry()
+        self.window_steps = max(1, window_steps)
+        self.churn_threshold = max(2, churn_threshold)
+        r = self.registry
+        self._compiles = r.counter(
+            "tdl_xla_compiles_total", "XLA backend compiles observed")
+        self._compile_seconds = r.counter(
+            "tdl_xla_compile_seconds_total", "Seconds spent in XLA backend compiles")
+        self._churn = r.counter(
+            "tdl_shape_churn_warnings_total",
+            "Shape-churn warnings (same function compiled repeatedly)")
+        self._sig_counter = r.counter(
+            "tdl_jit_new_signatures_total",
+            "Distinct jit call signatures first seen, per function",
+            labels=("fn",))
+        self._lock = threading.Lock()
+        self._step = 0
+        self._seen: Dict[str, set] = defaultdict(set)
+        self._recent: Dict[str, deque] = defaultdict(deque)  # (step,) of new sigs
+        self._warned_at: Dict[str, int] = {}
+        self.compile_count = 0
+        self.compile_seconds = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def install(self) -> "RecompileWatchdog":
+        _install_hook()
+        if self not in _ACTIVE:
+            _ACTIVE.append(self)
+        return self
+
+    def close(self) -> None:
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- signals -----------------------------------------------------------
+
+    def _on_compile(self, duration: float) -> None:
+        with self._lock:
+            self.compile_count += 1
+            self.compile_seconds += duration
+        self._compiles.inc()
+        self._compile_seconds.inc(duration)
+
+    def step(self) -> None:
+        with self._lock:
+            self._step += 1
+
+    def note_signature(self, fn_name: str, signature) -> None:
+        with self._lock:
+            if signature in self._seen[fn_name]:
+                return
+            self._seen[fn_name].add(signature)
+            step = self._step
+            recent = self._recent[fn_name]
+            recent.append(step)
+            while recent and recent[0] < step - self.window_steps:
+                recent.popleft()
+            fresh = len(recent)
+            warned = self._warned_at.get(fn_name)
+            should_warn = (fresh >= self.churn_threshold and
+                           (warned is None or step - warned >= self.window_steps))
+            if should_warn:
+                self._warned_at[fn_name] = step
+        self._sig_counter.labels(fn_name).inc()
+        if should_warn:
+            self._churn.inc()
+            logger.warning(
+                "recompile watchdog: %s saw %d distinct input signatures in "
+                "the last %d steps — shape churn recompiles the XLA "
+                "executable each time; pad or bucket your minibatch shapes",
+                fn_name, fresh, self.window_steps)
+
+    # -- reading -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "compiles": self.compile_count,
+                "compile_seconds": self.compile_seconds,
+                "steps": self._step,
+                "signatures": {k: len(v) for k, v in self._seen.items()},
+            }

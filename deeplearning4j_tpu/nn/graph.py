@@ -8,6 +8,7 @@ into ONE jit-compiled step, same as MultiLayerNetwork.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -17,6 +18,8 @@ import numpy as np
 from ..common.dtypes import to_jax
 from ..common.precision import amp_enabled, cast_floating, cast_input, compute_dtype
 from ..data.dataset import DataSet, MultiDataSet
+from ..monitoring import trace as _trace
+from ..monitoring import watchdogs as _watchdogs
 from ..eval.evaluation import Evaluation
 from ..ndarray.ndarray import NDArray
 from .conf import BatchNormalization, GlobalPoolingLayer, LastTimeStep, LSTM, GravesLSTM
@@ -232,6 +235,15 @@ class ComputationGraph(_LazyScoreMixin):
         xs, ys = stack(ins), stack(lbs)
         lm_s = stack(lms) if has_lm else None
         scan_fit = self._train_scan_fn(has_lm)
+        first = next(iter(xs.values()))
+        # per-STEP batch: iteration advances by K, rate listeners multiply
+        # by their iteration delta (same contract as _fit_batch)
+        self.last_batch_size = int(first.shape[1])
+        if _watchdogs.active():
+            _watchdogs.note_step()
+            _watchdogs.note_signature(
+                "ComputationGraph.train_scan",
+                _watchdogs.signature_of(xs, ys, lm_s))
         rng = jax.random.key(self.conf.seed ^ 0x5EED)
         self.params_, self.updater_state, self.bn_state, losses = scan_fit(
             self.params_, self.updater_state, self.bn_state,
@@ -304,11 +316,23 @@ class ComputationGraph(_LazyScoreMixin):
     def _fit_batch(self, inputs, labels, lmasks):
         step = self._train_step_fn()
         rng = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self.iteration)
-        self.params_, self.updater_state, self.bn_state, loss = step(
-            self.params_, self.updater_state, self.bn_state,
-            jnp.asarray(self.iteration, jnp.int32), jnp.asarray(self.epoch, jnp.int32),
-            inputs, labels, lmasks, rng,
-        )
+        first = next(iter(inputs.values()))
+        self.last_batch_size = int(first.shape[0])
+        if _watchdogs.active():  # recompile watchdog: shape-churn detection
+            _watchdogs.note_step()
+            _watchdogs.note_signature(
+                "ComputationGraph.train_step",
+                _watchdogs.signature_of(inputs, labels, lmasks))
+        # step span (chrome-trace event host-side + XProf step boundary)
+        # only when a trace profiler is attached; no-op context otherwise
+        with (_trace.step_span(self.iteration)
+              if _trace.get_trace_profiler() is not None
+              else contextlib.nullcontext()):
+            self.params_, self.updater_state, self.bn_state, loss = step(
+                self.params_, self.updater_state, self.bn_state,
+                jnp.asarray(self.iteration, jnp.int32), jnp.asarray(self.epoch, jnp.int32),
+                inputs, labels, lmasks, rng,
+            )
         self.score_ = loss  # lazy: syncs only when read
         self.iteration += 1
         for lst in self.listeners:

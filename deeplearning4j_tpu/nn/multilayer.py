@@ -15,6 +15,7 @@ serialization and parameter averaging.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -24,6 +25,8 @@ import numpy as np
 
 from ..common.dtypes import to_jax
 from ..common.precision import amp_enabled, cast_floating, cast_input, compute_dtype
+from ..monitoring import trace as _trace
+from ..monitoring import watchdogs as _watchdogs
 from ..data.dataset import DataSet
 from ..data.iterators import ArrayDataSetIterator, DataSetIterator, ListDataSetIterator
 from ..eval.evaluation import Evaluation, RegressionEvaluation
@@ -408,6 +411,14 @@ class MultiLayerNetwork(_LazyScoreMixin):
         lms = (jnp.stack([self._put(ds.labels_mask) for ds in datasets])
                if has_lm else None)
         scan_fit = self._train_scan_fn(has_fm, has_lm)
+        # per-STEP batch (iteration advances by K, so rate listeners multiply
+        # by their iteration delta — same contract as the _fit_batch path)
+        self.last_batch_size = int(xs.shape[1])
+        if _watchdogs.active():
+            _watchdogs.note_step()
+            _watchdogs.note_signature(
+                "MultiLayerNetwork.train_scan",
+                _watchdogs.signature_of(xs, ys, fms, lms))
         rng = jax.random.key(self.conf.seed ^ 0x5EED)
         self.params_, self.updater_state, self.bn_state, losses = scan_fit(
             self.params_, self.updater_state, self.bn_state,
@@ -430,11 +441,22 @@ class MultiLayerNetwork(_LazyScoreMixin):
         y = self._put(ds.labels)
         fmask = self._put(ds.features_mask)
         lmask = self._put(ds.labels_mask)
-        self.params_, self.updater_state, self.bn_state, loss = step(
-            self.params_, self.updater_state, self.bn_state,
-            jnp.asarray(self.iteration, jnp.int32), jnp.asarray(self.epoch, jnp.int32),
-            x, y, fmask, lmask, rng,
-        )
+        self.last_batch_size = int(x.shape[0])
+        if _watchdogs.active():  # recompile watchdog: shape-churn detection
+            _watchdogs.note_step()
+            _watchdogs.note_signature(
+                "MultiLayerNetwork.train_step",
+                _watchdogs.signature_of(x, y, fmask, lmask))
+        # step span (chrome-trace event host-side + XProf step boundary)
+        # only when a trace profiler is attached; no-op context otherwise
+        with (_trace.step_span(self.iteration)
+              if _trace.get_trace_profiler() is not None
+              else contextlib.nullcontext()):
+            self.params_, self.updater_state, self.bn_state, loss = step(
+                self.params_, self.updater_state, self.bn_state,
+                jnp.asarray(self.iteration, jnp.int32), jnp.asarray(self.epoch, jnp.int32),
+                x, y, fmask, lmask, rng,
+            )
         self.score_ = loss  # lazy: syncs only when read
         self.iteration += 1
         for lst in self.listeners:
@@ -483,6 +505,12 @@ class MultiLayerNetwork(_LazyScoreMixin):
         lmj = to_segs(self._put(lm_all))
         fmj = None if fm_all is None else to_segs(self._put(fm_all))
         rng = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self.iteration)
+        self.last_batch_size = B
+        if _watchdogs.active():
+            _watchdogs.note_step()
+            _watchdogs.note_signature(
+                "MultiLayerNetwork.tbptt_step",
+                _watchdogs.signature_of(xj, yj, fmj, lmj))
         scan_fit = self._tbptt_scan_fn(fmj is not None)
         args = (self.params_, self.updater_state, self.bn_state, rnn_states,
                 jnp.asarray(self.iteration, jnp.int32), jnp.asarray(self.epoch, jnp.int32),

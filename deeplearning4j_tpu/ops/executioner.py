@@ -14,6 +14,24 @@ import time
 
 from ..common.environment import env
 
+_OPS_COUNTER = None
+
+
+def _ops_counter():
+    """Registry counter for op dispatches (profiling-only path). Re-resolved
+    against the registry each call so a registry clear()/unregister can't
+    leave this incrementing a detached counter."""
+    global _OPS_COUNTER
+    from ..monitoring.registry import get_registry
+
+    reg = get_registry()
+    if _OPS_COUNTER is None or reg.get("tdl_ops_total") is not _OPS_COUNTER:
+        _OPS_COUNTER = reg.counter(
+            "tdl_ops_total",
+            "Eager op dispatches recorded by the executioner (profiling on)",
+            labels=("op",))
+    return _OPS_COUNTER
+
 
 class OpExecutioner:
     def __init__(self):
@@ -33,6 +51,7 @@ class OpExecutioner:
     def record(self, op_name: str, duration_ns: int = 0) -> None:
         if env().profiling:
             self.profiler.record(op_name, duration_ns)
+            _ops_counter().labels(op_name).inc()
 
     def check_numerics(self, name: str, arr) -> None:
         """NaN/Inf panic (DefaultOpExecutioner checkForAny/checkForInf)."""
@@ -56,3 +75,4 @@ def record_op(name: str) -> None:
     """Cheap hook called from NDArray ops; no-op unless profiling is on."""
     if env().profiling:
         _EXECUTIONER.profiler.record(name, 0)
+        _ops_counter().labels(name).inc()

@@ -10,11 +10,14 @@ stats + trace-event file for A/B diffing (ProfileAnalyzer pattern).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 @dataclass
@@ -88,7 +91,7 @@ class OpProfiler:
         for name, st in sorted(self.stats().items(), key=lambda kv: -kv[1]["total_ns"]):
             lines.append(f"  {name:<30} count={st['count']:<8} total={st['total_ns'] / 1e6:.3f}ms")
         out = "\n".join(lines)
-        print(out)
+        logger.info("%s", out)
         return out
 
     def to_chrome_trace(self, path: str) -> None:

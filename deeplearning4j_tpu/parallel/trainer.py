@@ -19,6 +19,7 @@ Reference parity (SURVEY §2.6, §3.4):
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
 import jax
@@ -27,7 +28,24 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..data.dataset import DataSet
+from ..monitoring.registry import get_registry
 from .mesh import AXIS_DATA, build_mesh
+
+
+def _trainer_metrics():
+    """Shared metric families for every trainer class (get-or-create)."""
+    r = get_registry()
+    return (
+        r.histogram("tdl_parallel_step_seconds",
+                    "Host-observed wall time of one distributed fit-batch "
+                    "dispatch (async: excludes device completion)",
+                    labels=("trainer",)),
+        r.counter("tdl_collective_bytes_total",
+                  "Logical payload bytes moved by training collectives",
+                  labels=("trainer", "kind")),
+        r.gauge("tdl_parallel_devices", "Devices participating in the mesh",
+                labels=("trainer",)),
+    )
 
 
 class ParallelTrainer:
@@ -51,6 +69,10 @@ class ParallelTrainer:
         self.sharding_rules = sharding_rules
         self._ndata = int(np.prod([self.mesh.shape[a] for a in (data_axis,) if a in self.mesh.shape]))
         self._placed = False
+        self._step_hist, self._coll_bytes, devices_gauge = _trainer_metrics()
+        self._trainer_label = type(self).__name__
+        devices_gauge.labels(self._trainer_label).set(self.mesh.devices.size)
+        self._grad_bytes: Optional[int] = None
 
     # -- placement ----------------------------------------------------------
 
@@ -118,6 +140,20 @@ class ParallelTrainer:
         self._fit_core(ds)
 
     def _fit_core(self, ds: DataSet):
+        t0 = time.perf_counter()
+        self._fit_core_inner(ds)
+        self._step_hist.labels(self._trainer_label).observe(time.perf_counter() - t0)
+        if self._ndata > 1:
+            # logical payload of the per-step gradient allreduce GSPMD
+            # compiles into the step: one gradient tree's worth of bytes
+            if self._grad_bytes is None:
+                self._grad_bytes = sum(
+                    getattr(l, "nbytes", 0)
+                    for l in jax.tree.leaves(self.net.params_))
+            self._coll_bytes.labels(self._trainer_label,
+                                    "grad_allreduce").inc(self._grad_bytes)
+
+    def _fit_core_inner(self, ds: DataSet):
         n = self.net
         from ..nn.multilayer import MultiLayerNetwork
 
@@ -235,6 +271,18 @@ class ParameterAveragingTrainingMaster:
         self.averaging_frequency = max(1, averaging_frequency)
         self.average_updater_state = average_updater_state
         self.batch_size_per_worker = batch_size_per_worker
+        r = get_registry()
+        self._coll_bytes = r.counter(
+            "tdl_collective_bytes_total",
+            "Logical payload bytes moved by training collectives",
+            labels=("trainer", "kind"))
+        self._trainer_label = type(self).__name__
+        # workers here are LOGICAL model replicas, not devices — a separate
+        # gauge keeps tdl_parallel_devices honest
+        r.gauge("tdl_parallel_workers",
+                "Logical workers in a parameter-averaging master",
+                labels=("trainer",)).labels(self._trainer_label).set(self.workers)
+        self._params_bytes: Optional[int] = None
 
     def fit(self, net, iterator, epochs: int = 1):
         replicas = [net] + [net.clone() for _ in range(self.workers - 1)]
@@ -262,6 +310,11 @@ class ParameterAveragingTrainingMaster:
         return net
 
     def _average(self, replicas):
+        if self._params_bytes is None:  # param sizes are fixed after init
+            self._params_bytes = sum(getattr(l, "nbytes", 0)
+                                     for l in jax.tree.leaves(replicas[0].params_))
+        self._coll_bytes.labels(self._trainer_label, "param_average").inc(
+            self._params_bytes * len(replicas))
         mean_params = jax.tree.map(
             lambda *xs: sum(xs) / len(xs), *[r.params_ for r in replicas])
         for r in replicas:

@@ -139,14 +139,25 @@ tick();setInterval(tick,2000);
 
 class _Handler(BaseHTTPRequestHandler):
     storage: StatsStorage = None  # injected
+    registry = None  # MetricsRegistry; None = the process default
 
     def log_message(self, *args):
         pass
 
+    def _registry(self):
+        if self.registry is not None:
+            return self.registry
+        from ..monitoring.registry import get_registry
+
+        return get_registry()
+
     def _html(self, body: str, code=200):
+        self._text(body, "text/html", code)
+
+    def _text(self, body: str, content_type: str, code=200):
         data = body.encode()
         self.send_response(code)
-        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -162,6 +173,15 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path in ("/", "/train", "/train/overview"):
             self._html(_PAGE)
+            return
+        if self.path == "/metrics":
+            # Prometheus text exposition over the monitoring registry: the
+            # machine-readable twin of the overview page (scrape target)
+            self._text(self._registry().to_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+            return
+        if self.path == "/metrics.json":
+            self._json(self._registry().snapshot())
             return
         if self.path == "/sessions":
             self._json(self.storage.session_ids())
@@ -387,6 +407,16 @@ class UIServer:
             self._start(storage)
         else:
             self._httpd.RequestHandlerClass.storage = storage
+
+    def attach_registry(self, registry) -> None:
+        """Serve a specific ``MetricsRegistry`` at ``/metrics`` /
+        ``/metrics.json`` (default: the process-wide registry, so attaching
+        is only needed for isolated registries, e.g. in tests)."""
+        if self._httpd is None:
+            self._start(self._storages[0] if self._storages else StatsStorage())
+        self._httpd.RequestHandlerClass.registry = registry
+
+    attachRegistry = attach_registry
 
     def attach_model(self, net) -> None:
         """Populate the model tab (C14 model-graph tier): /train/model and

@@ -155,18 +155,60 @@ class RemoteUIStatsStorageRouter(StatsStorage):
     """Route stats records to a remote UIServer over HTTP (reference:
     ``org.deeplearning4j.ui.model.storage.impl.RemoteUIStatsStorageRouter``):
     a trainer on one host POSTs to the dashboard host's ``/remoteReceive``.
-    Failed posts are retried with backoff up to ``retry_count`` and then
-    dropped with a warning — stats routing must never stall training
-    (the reference behaves the same way)."""
+
+    Posting happens on a background daemon thread behind a BOUNDED queue:
+    ``put_record`` never blocks the training thread, a down dashboard costs
+    at most one queue's worth of dropped records (with a warning), and
+    retry backoff sleeps happen off-thread. ``flush()`` waits for the queue
+    to drain (tests / orderly shutdown); the reference's
+    async-with-drop-on-failure semantics are preserved."""
+
+    _STOP = object()  # sentinel shutting down the worker thread
 
     def __init__(self, address: str, retry_count: int = 3,
-                 retry_backoff_ms: int = 100):
+                 retry_backoff_ms: int = 100, queue_size: int = 256):
         self.address = address.rstrip("/")
         self.retry_count = retry_count
         self.retry_backoff_ms = retry_backoff_ms
         self.dropped = 0
+        import queue as _queue
+        import threading as _threading
 
-    def put_record(self, record: Dict[str, Any]) -> None:
+        self._queue: "_queue.Queue" = _queue.Queue(maxsize=max(1, queue_size))
+        self._lock = _threading.Lock()
+        self._thread = None
+        self._atexit_registered = False
+
+    def _ensure_worker(self) -> None:
+        import threading as _threading
+
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = _threading.Thread(
+                target=self._drain, daemon=True, name="tdl-stats-router")
+            self._thread.start()
+            if not self._atexit_registered:  # once per router, not per restart
+                import atexit
+
+                # best-effort drain at interpreter exit: daemon threads die
+                # mid-post otherwise, silently losing the final records
+                atexit.register(self.flush, 10.0)
+                self._atexit_registered = True
+
+    def _drain(self) -> None:
+        while True:
+            rec = self._queue.get()
+            try:
+                if rec is self._STOP:
+                    return
+                self._post(rec)
+            finally:
+                self._queue.task_done()
+
+    def _post(self, record: Dict[str, Any]) -> None:
         import json as _json
         import time as _time
         import urllib.request
@@ -183,13 +225,60 @@ class RemoteUIStatsStorageRouter(StatsStorage):
             except Exception:
                 if attempt < self.retry_count - 1:  # no pointless final sleep
                     _time.sleep(self.retry_backoff_ms / 1000.0 * (attempt + 1))
+        self._drop("after %d attempts" % self.retry_count)
+
+    def _drop(self, why: str) -> None:
         self.dropped += 1
         import warnings
 
         warnings.warn(
-            f"RemoteUIStatsStorageRouter: dropped a stats record after "
-            f"{self.retry_count} attempts to {self.address} "
-            f"({self.dropped} dropped total)", stacklevel=2)
+            f"RemoteUIStatsStorageRouter: dropped a stats record {why} "
+            f"to {self.address} ({self.dropped} dropped total)", stacklevel=3)
+
+    def put_record(self, record: Dict[str, Any]) -> None:
+        import queue as _queue
+
+        self._ensure_worker()
+        try:
+            self._queue.put_nowait(record)
+        except _queue.Full:
+            # the dashboard is down or slow; training must not stall
+            self._drop("(queue full)")
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued record was posted (or dropped). Returns
+        False if ``timeout`` elapsed first."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.time() + timeout
+        while self._queue.unfinished_tasks:
+            if deadline is not None and _time.time() > deadline:
+                return False
+            _time.sleep(0.005)
+        return True
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain and stop the worker thread (best effort: if the queue is
+        still backed up after ``timeout`` the daemon worker is left draining
+        and remains this router's worker — no second thread is spawned)."""
+        import queue as _queue
+
+        self.flush(timeout)
+        t = self._thread
+        if t is not None and t.is_alive():
+            try:
+                self._queue.put_nowait(self._STOP)
+            except _queue.Full:
+                return  # worker still backed up; leave it running
+            t.join(timeout)
+            if t.is_alive():
+                return
+        self._thread = None
+        if self._atexit_registered:
+            import atexit
+
+            atexit.unregister(self.flush)
+            self._atexit_registered = False
 
     def records(self, session_id=None):
         raise NotImplementedError("router is write-only; read on the UI host")

@@ -1,0 +1,438 @@
+"""Monitoring subsystem tests: registry/exposition, /metrics endpoints,
+watchdogs, trace spans, OpProfiler chrome-trace round-trip, print lint
+(ISSUE 1 acceptance criteria)."""
+
+import ast
+import json
+import logging
+import pathlib
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitoring import (
+    DeviceMemoryWatchdog,
+    MetricsListener,
+    MetricsRegistry,
+    RecompileWatchdog,
+    get_registry,
+    set_trace_profiler,
+    signature_of,
+    span,
+)
+from deeplearning4j_tpu.monitoring import trace as trace_mod
+
+_LABEL_RE = r'[a-zA-Z0-9_]+="(?:[^"\\]|\\.)*"'
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{%s(,%s)*\})?"
+    r" (-?[0-9.eE+-]+|\+Inf|-Inf|NaN)$" % (_LABEL_RE, _LABEL_RE))
+
+
+def _assert_valid_prometheus(text: str) -> None:
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"invalid exposition line: {line!r}"
+
+
+def _net():
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(n=16):
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, 4).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, n)]
+    return DataSet(X, Y)
+
+
+# ------------------------------------------------------------------ registry
+
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    c = r.counter("requests_total", "reqs", labels=("op",))
+    c.labels("matmul").inc()
+    c.labels("matmul").inc(2)
+    c.labels(op="add").inc()
+    assert c.labels("matmul").value == 3
+    with pytest.raises(ValueError):
+        c.labels("matmul").inc(-1)  # counters only go up
+
+    g = r.gauge("temp")
+    g.set(4.5)
+    g.set_to_max(2.0)  # lower value must NOT lower the watermark via max
+    assert g.value == 4.5
+    g.inc(0.5)
+    assert g.value == 5.0
+
+    h = r.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = r.snapshot()["lat_seconds"]["series"][0]
+    assert snap["count"] == 4 and snap["inf"] == 1
+    assert snap["buckets"] == {"0.1": 1, "1": 1, "10": 1}
+
+
+def test_registry_get_or_create_and_mismatch():
+    r = MetricsRegistry()
+    a = r.counter("x_total", "first")
+    assert r.counter("x_total") is a  # same object, no coordination needed
+    with pytest.raises(ValueError):
+        r.gauge("x_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        r.counter("x_total", labels=("op",))  # labels mismatch
+
+
+def test_prometheus_exposition_format():
+    r = MetricsRegistry()
+    r.counter("c_total", "a counter", labels=("k",)).labels('we"ird\n').inc()
+    r.gauge("g").set(1.25)
+    h = r.histogram("h_seconds", "hist", buckets=(0.5, 2.0))
+    h.observe(0.1)
+    h.observe(1.0)
+    h.observe(100.0)
+    text = r.to_prometheus()
+    _assert_valid_prometheus(text)
+    assert "# TYPE h_seconds histogram" in text
+    # cumulative buckets ending at +Inf == count
+    assert 'h_seconds_bucket{le="0.5"} 1' in text
+    assert 'h_seconds_bucket{le="2"} 2' in text
+    assert 'h_seconds_bucket{le="+Inf"} 3' in text
+    assert "h_seconds_count 3" in text
+    # label escaping survives round-trip
+    assert 'c_total{k="we\\"ird\\n"} 1' in text
+
+
+# ---------------------------------------------------- /metrics on the UIServer
+
+
+def test_metrics_endpoint_after_fit():
+    """Acceptance: GET /metrics returns valid Prometheus text incl. step
+    duration histogram, samples/sec gauge, compile counter, device-memory
+    high-water gauge after a short fit on the CPU backend."""
+    from deeplearning4j_tpu.ui import UIServer
+
+    reg = MetricsRegistry()
+    net = _net()
+    with RecompileWatchdog(registry=reg):
+        net.add_listeners(MetricsListener(registry=reg, score_every=2,
+                                          memory_every=4))
+        ds = _batch()
+        for _ in range(10):
+            net._fit_batch(ds)
+    assert net.last_batch_size == 16  # fit loops now record throughput basis
+
+    server = UIServer(port=0)
+    server.attach_registry(reg)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            text = resp.read().decode()
+        assert "text/plain" in ctype and "version=0.0.4" in ctype
+        _assert_valid_prometheus(text)
+        for family in ("tdl_step_duration_seconds_bucket",
+                       "tdl_samples_per_sec",
+                       "tdl_xla_compiles_total",
+                       "tdl_device_memory_high_water_bytes",
+                       "tdl_score",
+                       "tdl_iterations_total"):
+            assert family in text, f"missing metric family {family}"
+
+        with urllib.request.urlopen(base + "/metrics.json", timeout=10) as resp:
+            snap = json.loads(resp.read())
+        assert snap["tdl_iterations_total"]["series"][0]["value"] == 10
+        assert snap["tdl_step_duration_seconds"]["series"][0]["count"] == 9
+        assert snap["tdl_samples_per_sec"]["series"][0]["value"] > 0
+    finally:
+        server.stop()
+
+
+def test_metrics_endpoint_defaults_to_process_registry():
+    from deeplearning4j_tpu.ui import UIServer
+
+    get_registry().counter("tdl_default_probe_total").inc()
+    server = UIServer(port=0)
+    server.attach_registry(None)  # explicit: serve the process default
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "tdl_default_probe_total" in text
+    finally:
+        server.stop()
+        get_registry().unregister("tdl_default_probe_total")
+
+
+# ------------------------------------------------------------------ watchdogs
+
+
+def test_recompile_watchdog_shape_churn_warns_and_counts(caplog):
+    """Acceptance: provoke shape-churn through the real fit path and assert
+    the warning + counter increment."""
+    reg = MetricsRegistry()
+    net = _net()
+    with RecompileWatchdog(registry=reg, window_steps=20, churn_threshold=3):
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.monitoring"):
+            for n in (6, 7, 8, 9):  # batch-size churn: new jit signature each
+                net._fit_batch(_batch(n))
+    assert any("shape churn" in r.message for r in caplog.records)
+    churn = reg.get("tdl_shape_churn_warnings_total")
+    assert churn is not None and churn.value >= 1
+    sigs = reg.get("tdl_jit_new_signatures_total")
+    assert sigs.labels("MultiLayerNetwork.train_step").value == 4
+    # real XLA compiles were observed and timed
+    assert reg.get("tdl_xla_compiles_total").value > 0
+    assert reg.get("tdl_xla_compile_seconds_total").value > 0
+
+
+def test_recompile_watchdog_stable_shapes_quiet(caplog):
+    reg = MetricsRegistry()
+    net = _net()
+    ds = _batch()
+    with RecompileWatchdog(registry=reg, window_steps=20, churn_threshold=3):
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.monitoring"):
+            for _ in range(8):
+                net._fit_batch(ds)
+    assert not any("shape churn" in r.message for r in caplog.records)
+    assert reg.get("tdl_shape_churn_warnings_total").value == 0
+    assert reg.get("tdl_jit_new_signatures_total").labels(
+        "MultiLayerNetwork.train_step").value == 1
+
+
+def test_signature_of_distinguishes_shape_and_dtype():
+    import jax.numpy as jnp
+
+    a = jnp.ones((2, 3))
+    assert signature_of(a) == signature_of(jnp.zeros((2, 3)))
+    assert signature_of(a) != signature_of(jnp.ones((3, 2)))
+    assert signature_of(a) != signature_of(jnp.ones((2, 3), jnp.int32))
+    assert signature_of({"x": a, "m": None}) == signature_of({"x": a, "m": None})
+
+
+def test_device_memory_watchdog_cpu_fallback_high_water():
+    reg = MetricsRegistry()
+    wd = DeviceMemoryWatchdog(registry=reg)
+    sampled = wd.sample()
+    assert sampled  # something was sampled even on the stats-less CPU backend
+    hw = reg.get("tdl_device_memory_high_water_bytes")
+    label = next(iter(sampled))
+    first = hw.labels(label).value
+    assert first > 0
+    wd.sample()
+    assert hw.labels(label).value >= first  # watermark never decreases
+
+
+def test_device_memory_watchdog_threshold_dump(caplog):
+    import jax.numpy as jnp
+
+    keep = jnp.ones((128, 128))  # a live buffer for the dump to find
+    reg = MetricsRegistry()
+    wd = DeviceMemoryWatchdog(registry=reg, threshold_bytes=1,
+                              dump_live_buffers=True, dump_top=3)
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.monitoring"):
+        wd.sample()
+    assert reg.get("tdl_device_memory_threshold_exceeded_total").value == 1
+    msgs = [r.message for r in caplog.records]
+    assert any("exceeds threshold" in m for m in msgs)
+    assert any("MB" in m for m in msgs[1:]), "live-buffer dump missing"
+    del keep
+
+
+# ------------------------------------------------------------------- tracing
+
+
+def test_spans_nest_and_feed_op_profiler():
+    from deeplearning4j_tpu.ops.profiler import OpProfiler, ProfilerConfig
+
+    prof = OpProfiler(ProfilerConfig(trace_events=True))
+    with span("fit", profiler=prof):
+        assert trace_mod.current_span_path() == "fit"
+        with span("step", profiler=prof):
+            assert trace_mod.current_span_path() == "fit/step"
+    assert trace_mod.current_span_path() == ""
+    stats = prof.stats()
+    assert set(stats) == {"fit", "fit/step"}
+    # enclosing span covers the nested one
+    assert stats["fit"]["total_ns"] >= stats["fit/step"]["total_ns"]
+
+
+def test_fit_step_spans_land_in_chrome_trace(tmp_path):
+    """One chrome-trace file shows fit-step spans + op events together."""
+    from deeplearning4j_tpu.ops.profiler import (OpProfiler, ProfileAnalyzer,
+                                                 ProfilerConfig)
+
+    prof = OpProfiler(ProfilerConfig(trace_events=True))
+    set_trace_profiler(prof)
+    try:
+        net = _net()
+        ds = _batch()
+        for _ in range(3):
+            net._fit_batch(ds)
+        prof.record("custom_op", 1000)  # op event alongside the spans
+    finally:
+        set_trace_profiler(None)
+    path = str(tmp_path / "trace.json")
+    prof.to_chrome_trace(path)
+    stats = ProfileAnalyzer.load(path)
+    assert stats["train"].count == 3
+    assert stats["custom_op"].count == 1
+
+
+def test_op_profiler_chrome_trace_roundtrip(tmp_path):
+    """Satellite: OpProfiler.to_chrome_trace → ProfileAnalyzer.load/compare
+    round-trips counts and durations."""
+    from deeplearning4j_tpu.ops.profiler import (OpProfiler, ProfileAnalyzer,
+                                                 ProfilerConfig)
+
+    prof = OpProfiler(ProfilerConfig(trace_events=True))
+    with prof.timed("matmul"):
+        np.dot(np.ones((64, 64)), np.ones((64, 64)))
+    prof.record("add", 2_000)
+    prof.record("add", 3_000)
+    a = str(tmp_path / "a.json")
+    prof.to_chrome_trace(a)
+
+    loaded = ProfileAnalyzer.load(a)
+    assert loaded["add"].count == 2
+    assert loaded["matmul"].count == 1
+    # ns → us → ns round-trip keeps microsecond resolution
+    assert abs(loaded["add"].total_ns - 5_000) < 2_000
+    assert loaded["matmul"].total_ns > 0
+
+    rows = ProfileAnalyzer.compare(a, a)
+    assert {r["op"] for r in rows} == {"matmul", "add"}
+    assert all(r["delta_ns"] == 0 for r in rows)
+    assert all(r["a_count"] == r["b_count"] for r in rows)
+
+
+# ---------------------------------------------------------- listener satellites
+
+
+class _StubModel:
+    """Counts score() reads; exposes the listener-facing surface."""
+
+    def __init__(self):
+        self.score_calls = 0
+        self.last_batch_size = 32
+        self.epoch = 0
+
+    def score(self):
+        self.score_calls += 1
+        return 0.25
+
+    @property
+    def score_(self):
+        return 0.25
+
+
+def test_score_iteration_listener_single_score_read(caplog):
+    from deeplearning4j_tpu.listeners import ScoreIterationListener
+
+    m = _StubModel()
+    lst = ScoreIterationListener(print_iterations=1)
+    with caplog.at_level(logging.INFO, logger="deeplearning4j_tpu"):
+        lst.iteration_done(m, 1, 0)
+    assert m.score_calls == 1  # was 2: score() evaluated twice per report
+    assert any("Score at iteration 1" in r.message for r in caplog.records)
+
+
+def test_time_iteration_listener_lazy_clock_and_clamp():
+    import time as _time
+
+    from deeplearning4j_tpu.listeners import TimeIterationListener
+
+    lst = TimeIterationListener(total_iterations=100, frequency=0)  # no ZeroDivisionError
+    assert lst.frequency == 1
+    m = _StubModel()
+    built_at = _time.perf_counter()
+    _time.sleep(0.05)  # construction-to-fit gap must not skew the ETA clock
+    lst.iteration_done(m, 1, 0)
+    assert lst._start >= built_at + 0.04  # clock started at first iteration
+    lst.iteration_done(m, 2, 0)  # frequency=1 path exercises the ETA math
+
+
+def test_performance_listener_reports_rss(caplog):
+    from deeplearning4j_tpu.listeners import PerformanceListener
+
+    reg = MetricsRegistry()
+    lst = PerformanceListener(frequency=1, registry=reg)
+    m = _StubModel()
+    with caplog.at_level(logging.INFO, logger="deeplearning4j_tpu"):
+        lst.iteration_done(m, 1, 0)
+        lst.iteration_done(m, 2, 0)
+    assert lst.last_rss_bytes > 0
+    assert reg.get("tdl_host_rss_bytes").value == lst.last_rss_bytes
+    assert reg.get("tdl_listener_samples_per_sec").value > 0
+    assert any("host RSS" in r.message for r in caplog.records)
+
+
+def test_fit_scan_reports_per_step_batch():
+    """last_batch_size is per STEP (rate listeners scale by iteration
+    delta); fit_scan must not report the whole dispatch's sample count."""
+    reg = MetricsRegistry()
+    net = _net()
+    net.add_listeners(MetricsListener(registry=reg))
+    net._fit_batch(_batch(8))  # seed the listener's (time, iteration) mark
+    net.fit_scan([_batch(8) for _ in range(4)])
+    assert net.last_batch_size == 8
+    assert net.iteration == 5
+    sps = reg.get("tdl_samples_per_sec")
+    assert sps.labels("MultiLayerNetwork").value > 0
+
+
+def test_metrics_listener_epochs_and_fit_wiring():
+    reg = MetricsRegistry()
+    net = _net()
+    net.add_listeners(MetricsListener(registry=reg, score_every=1))
+    net.fit(_batch(), epochs=2)
+    snap = reg.snapshot()
+    assert snap["tdl_epochs_total"]["series"][0]["value"] == 2
+    assert snap["tdl_iterations_total"]["series"][0]["value"] == 2
+    assert snap["tdl_score"]["series"][0]["value"] > 0
+
+
+# ------------------------------------------------------------------ print lint
+
+
+_LINT_ALLOWED = (
+    # UI/CLI surfaces: rendering to a terminal/browser is their job
+    "ui/",
+)
+
+
+def test_no_bare_print_in_library_code():
+    """Repo lint (ISSUE 1 satellite): library code reports through logging
+    or the metrics registry, never bare print()."""
+    root = pathlib.Path(__file__).resolve().parent.parent / "deeplearning4j_tpu"
+    offenders = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel.startswith(_LINT_ALLOWED):
+            continue
+        tree = ast.parse(path.read_text(), filename=rel)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "bare print() in library code (use logging or the metrics "
+        f"registry): {offenders}")

@@ -1,6 +1,7 @@
 """UI stats pipeline + JSON serving tests (SURVEY §2.4 C14, §2.6 S7, §5.1)."""
 
 import json
+import time
 import urllib.request
 
 import numpy as np
@@ -176,6 +177,7 @@ def test_remote_stats_routing():
         router = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{server.port}")
         router.put_record({"session": "remote", "iteration": 1, "score": 0.9})
         router.put_record({"session": "remote", "iteration": 2, "score": 0.7})
+        assert router.flush(timeout=10)  # posting is async (daemon thread)
         recs = storage.records("remote")
         assert [r["score"] for r in recs] == [0.9, 0.7]
         # the dashboard data endpoint sees the remotely-routed records
@@ -197,8 +199,12 @@ def test_remote_router_drops_when_unreachable():
 
     router = RemoteUIStatsStorageRouter("http://127.0.0.1:1", retry_count=2,
                                         retry_backoff_ms=1)
+    t0 = time.perf_counter()
     router.put_record({"score": 1.0})  # must not raise / stall
+    assert time.perf_counter() - t0 < 0.5  # backoff happens OFF-thread
+    assert router.flush(timeout=10)
     assert router.dropped == 1
+    router.close()
 
 
 def test_arbiter_tab():
