@@ -24,10 +24,13 @@ Enable globally for training loops with TDL_DEBUG_BUFFERS=1
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Dict, List, Optional
 
 import jax
+
+log = logging.getLogger(__name__)
 
 
 def _live_stats() -> Dict[str, float]:
@@ -37,8 +40,8 @@ def _live_stats() -> Dict[str, float]:
         n += 1
         try:
             nbytes += a.nbytes
-        except Exception:
-            pass
+        except Exception as e:  # deleted-mid-iteration buffers have no nbytes
+            log.debug("live array %r has unreadable nbytes: %s", type(a), e)
     return {"count": n, "bytes": float(nbytes)}
 
 

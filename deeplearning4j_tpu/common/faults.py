@@ -1,0 +1,165 @@
+"""Deterministic fault injection for chaos-testing the gang supervisor.
+
+The recovery path (heartbeat stall → gang kill → respawn → restore from
+checkpoint) is only trustworthy if it is exercised by tests, and real faults
+are not reproducible. This module turns an env var into deterministic faults
+fired from hooks inside the REAL code paths (``ParallelTrainer._fit_core``,
+``TrainingCheckpointer.save``), so a chaos test drives the exact machinery a
+production preemption would.
+
+``TDL_FAULT_SPEC`` grammar — ``;``-separated clauses::
+
+    crash@iter=7,rank=1          hard os._exit at train iteration 7 on rank 1
+    hang@iter=5,rank=0           wedge (sleep forever) at iteration 5, rank 0
+    slow_ckpt_io=2.0             sleep 2.0s inside every checkpoint write
+
+``crash``/``hang`` clauses fire only in the gang's FIRST incarnation by
+default (``TDL_GANG_RESTART_COUNT=0``), so a supervisor restart replays the
+faulted iteration cleanly. ``every=1`` makes a clause fire in every
+incarnation (the repeated-crash-at-same-iteration fatal-classification test);
+``restart=N`` pins it to incarnation N.
+
+Rank defaults come from the launcher's ``TDL_PROCESS_ID`` env so the injector
+never has to import jax; a clause without ``rank=`` fires on every rank.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+ENV_SPEC = "TDL_FAULT_SPEC"
+ENV_INCARNATION = "TDL_GANG_RESTART_COUNT"
+ENV_RANK = "TDL_PROCESS_ID"
+
+#: exit code of an injected crash — distinguishable from real worker errors
+CRASH_EXIT_CODE = 43
+
+
+@dataclass
+class Fault:
+    kind: str                     # "crash" | "hang" | "slow_ckpt_io"
+    params: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def iteration(self) -> Optional[int]:
+        v = self.params.get("iter")
+        return int(v) if v is not None else None
+
+    @property
+    def rank(self) -> Optional[int]:
+        v = self.params.get("rank")
+        return int(v) if v is not None else None
+
+    @property
+    def value(self) -> float:
+        return float(self.params.get("value", "0"))
+
+    def fires_in_incarnation(self, incarnation: int) -> bool:
+        if self.params.get("every") in ("1", "true"):
+            return True
+        return incarnation == int(self.params.get("restart", "0"))
+
+
+def parse_fault_spec(spec: str) -> List[Fault]:
+    """``crash@iter=7,rank=1;slow_ckpt_io=2.0`` → [Fault, Fault]."""
+    faults = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "@" in clause:
+            kind, _, rest = clause.partition("@")
+            params = {}
+            for kv in rest.split(","):
+                k, _, v = kv.partition("=")
+                if not _:
+                    raise ValueError(f"bad fault param {kv!r} in {clause!r}")
+                params[k.strip()] = v.strip()
+        elif "=" in clause:
+            kind, _, v = clause.partition("=")
+            params = {"value": v.strip()}
+        else:
+            kind, params = clause, {}
+        kind = kind.strip()
+        if kind not in ("crash", "hang", "slow_ckpt_io"):
+            raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
+        faults.append(Fault(kind, params))
+    return faults
+
+
+class FaultInjector:
+    """Evaluates fault clauses at named code sites.
+
+    Sites:
+
+    - ``train_step`` (iteration=N): ``crash`` / ``hang`` clauses
+    - ``ckpt_write``: ``slow_ckpt_io`` clauses
+    """
+
+    def __init__(self, faults: List[Fault], rank: Optional[int] = None,
+                 incarnation: Optional[int] = None):
+        self.faults = faults
+        self.rank = rank if rank is not None else int(os.environ.get(ENV_RANK, "0"))
+        self.incarnation = (incarnation if incarnation is not None
+                            else int(os.environ.get(ENV_INCARNATION, "0")))
+
+    @classmethod
+    def from_env(cls) -> "FaultInjector":
+        return cls(parse_fault_spec(os.environ.get(ENV_SPEC, "")))
+
+    def _matches(self, f: Fault, iteration: Optional[int]) -> bool:
+        if f.rank is not None and f.rank != self.rank:
+            return False
+        if f.iteration is not None and f.iteration != iteration:
+            return False
+        return f.fires_in_incarnation(self.incarnation)
+
+    def fire(self, site: str, iteration: Optional[int] = None) -> None:
+        for f in self.faults:
+            if site == "train_step" and f.kind in ("crash", "hang"):
+                if not self._matches(f, iteration):
+                    continue
+                if f.kind == "crash":
+                    log.warning("fault injection: crash at iteration %s rank %s "
+                                "(incarnation %s)", iteration, self.rank,
+                                self.incarnation)
+                    # hard exit, no cleanup — models a segfault/preemption
+                    os._exit(CRASH_EXIT_CODE)
+                log.warning("fault injection: hang at iteration %s rank %s "
+                            "(incarnation %s)", iteration, self.rank,
+                            self.incarnation)
+                while True:  # wedged worker: alive but makes no progress
+                    time.sleep(1.0)
+            elif site == "ckpt_write" and f.kind == "slow_ckpt_io":
+                # unlike crash/hang, slow IO fires in EVERY incarnation
+                # unless explicitly pinned with restart=N
+                if ("restart" not in f.params
+                        or f.fires_in_incarnation(self.incarnation)):
+                    time.sleep(f.value)
+
+
+_cached: Optional[FaultInjector] = None
+_cached_key: Optional[tuple] = None
+
+
+def fault_point(site: str, iteration: Optional[int] = None) -> None:
+    """Library hook: no-op unless ``TDL_FAULT_SPEC`` is set (one dict lookup
+    on the hot path). The injector is rebuilt whenever the env contract
+    (spec, rank, incarnation) changes, so in-process tests can flip any of
+    the three between cases."""
+    global _cached, _cached_key
+    spec = os.environ.get(ENV_SPEC)
+    if not spec:
+        return
+    key = (spec, os.environ.get(ENV_RANK, "0"),
+           os.environ.get(ENV_INCARNATION, "0"))
+    if _cached is None or key != _cached_key:
+        _cached = FaultInjector.from_env()
+        _cached_key = key
+    _cached.fire(site, iteration)

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..common import jax_compat
+
 _NEG_INF = -1e30
 
 
@@ -543,7 +545,7 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False, scale: Opti
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    n = jax.lax.axis_size(axis_name)
+    n = jax_compat.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     B, H, Tl, D = q.shape
 
@@ -600,7 +602,7 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
     ppermute pipeline; the ring wins at very long T where even T×T/P tiles
     blow HBM, Ulysses wins on latency for moderate T.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = jax_compat.axis_size(axis_name)
     H = q.shape[1]
     if H % n:
         raise ValueError(f"ulysses needs heads ({H}) divisible by axis size ({n})")

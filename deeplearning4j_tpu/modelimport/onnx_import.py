@@ -377,7 +377,15 @@ def _slice(ctx, ins, attrs, node):
             # [-1, n-1]; express as reverse + positive-stride slice
             # (r5 review: the positive-only clamp dropped index 0)
             s_c = min(max(s + n if s < 0 else s, 0), n - 1)
-            e_c = min(max(e + n if e >= -n else -1, -1), n - 1)
+            # e < -n is the INT64_MIN "through index 0" sentinel → -1; only
+            # NEGATIVE e gets the +n wrap (ADVICE r5: wrapping non-negative e
+            # made starts=-1, ends=2, steps=-1 on length-5 yield [] not [4,3])
+            if e < -n:
+                e_c = -1
+            elif e < 0:
+                e_c = e + n
+            else:
+                e_c = min(e, n - 1)
             begin[a] = n - 1 - s_c
             end[a] = n - 1 - e_c
             strides[a] = -st

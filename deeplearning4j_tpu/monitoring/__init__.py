@@ -11,6 +11,7 @@
   wires a network's fit loop into the registry.
 """
 
+from .heartbeat import HeartbeatWriter, maybe_beat, read_heartbeat
 from .listener import MetricsListener
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
@@ -26,6 +27,9 @@ __all__ = [
     "MetricsRegistry",
     "get_registry",
     "MetricsListener",
+    "HeartbeatWriter",
+    "maybe_beat",
+    "read_heartbeat",
     "Span",
     "span",
     "step_span",

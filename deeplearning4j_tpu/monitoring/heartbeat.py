@@ -1,0 +1,89 @@
+"""Per-rank heartbeat files — the worker-side half of gang supervision.
+
+Each worker in a supervised gang writes ``hb_rank{r}.json`` (iteration, pid,
+wall time) into ``TDL_HEARTBEAT_DIR`` from its fit loop; the parent-side
+``GangSupervisor`` polls the files and treats a stale mtime as a hung rank.
+File mtime (not the embedded timestamp) carries liveness, so supervisor and
+worker need no clock agreement beyond sharing a filesystem — the same
+contract the checkpoint shards already rely on.
+
+Writes are atomic (tmp + rename) so the supervisor never reads a torn file,
+and throttled by ``TDL_HEARTBEAT_INTERVAL`` seconds so production steps are
+not taxed with an fsync per iteration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional, Tuple
+
+ENV_DIR = "TDL_HEARTBEAT_DIR"
+ENV_INTERVAL = "TDL_HEARTBEAT_INTERVAL"
+ENV_RANK = "TDL_PROCESS_ID"
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"hb_rank{rank}.json")
+
+
+class HeartbeatWriter:
+    def __init__(self, directory: str, rank: int, interval: float = 1.0):
+        self.path = heartbeat_path(directory, rank)
+        self.rank = rank
+        self.interval = max(0.0, float(interval))
+        self._last_write = 0.0
+        self.iteration = -1
+        os.makedirs(directory, exist_ok=True)
+
+    def beat(self, iteration: int) -> bool:
+        """Record progress; returns True if a file write happened."""
+        now = time.monotonic()
+        if self._last_write and now - self._last_write < self.interval:
+            self.iteration = int(iteration)
+            return False
+        self._last_write = now
+        self.iteration = int(iteration)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"iteration": int(iteration), "pid": os.getpid(),
+                       "time": time.time()}, f)
+        os.replace(tmp, self.path)
+        return True
+
+
+def read_heartbeat(directory: str, rank: int) -> Optional[Tuple[int, float]]:
+    """(iteration, mtime) of rank's heartbeat, or None before the first beat.
+    A beat mid-replace or half-written legacy file reads as None — the
+    supervisor just sees the previous poll's value next round."""
+    path = heartbeat_path(directory, rank)
+    try:
+        mtime = os.path.getmtime(path)
+        with open(path) as f:
+            data = json.load(f)
+        return int(data["iteration"]), mtime
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+_writer: Optional[HeartbeatWriter] = None
+_writer_key: Optional[Tuple[str, int, float]] = None
+
+
+def maybe_beat(iteration: int) -> None:
+    """Fit-loop hook: writes a heartbeat iff ``TDL_HEARTBEAT_DIR`` is set
+    (one env dict lookup when unsupervised). The cached writer is rebuilt
+    whenever the env contract (dir, rank, interval) changes, so in-process
+    supervisors/tests that re-point the dir never beat into a stale one."""
+    global _writer, _writer_key
+    directory = os.environ.get(ENV_DIR)
+    if not directory:
+        return
+    key = (directory,
+           int(os.environ.get(ENV_RANK, "0")),
+           float(os.environ.get(ENV_INTERVAL, "1.0")))
+    if _writer is None or key != _writer_key:
+        _writer = HeartbeatWriter(key[0], rank=key[1], interval=key[2])
+        _writer_key = key
+    _writer.beat(iteration)

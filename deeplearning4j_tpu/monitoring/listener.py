@@ -19,6 +19,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from . import heartbeat
 from .registry import MetricsRegistry, get_registry
 from .watchdogs import DeviceMemoryWatchdog
 
@@ -54,6 +55,10 @@ class MetricsListener:
         self._last: dict = {}
 
     def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        # supervised-gang liveness: nets not driven through ParallelTrainer
+        # still heartbeat when a MetricsListener is attached (no-op unless
+        # TDL_HEARTBEAT_DIR is set)
+        heartbeat.maybe_beat(iteration)
         name = type(model).__name__
         now = time.perf_counter()
         self._iterations.labels(name).inc()

@@ -57,12 +57,14 @@ def default_plugin_path() -> Optional[str]:
         return env
     try:
         import libtpu
-
-        path = os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")
-        if os.path.exists(path):
-            return path
-    except Exception:
-        pass
+    except ImportError:  # no TPU wheel on this host: caller falls back
+        return None
+    mod_file = getattr(libtpu, "__file__", None)
+    if mod_file is None:  # namespace-package remnant of a broken uninstall
+        return None
+    path = os.path.join(os.path.dirname(mod_file), "libtpu.so")
+    if os.path.exists(path):
+        return path
     return None
 
 

@@ -8,6 +8,7 @@ from .trainer import (
 )
 from .wrapper import ParallelWrapper
 from .inference import ParallelInference
+from .supervisor import GangFailedError, GangSupervisor
 from . import collectives, compression, launcher
 
 __all__ = [
@@ -27,6 +28,8 @@ __all__ = [
     "SharedTrainingMaster",
     "ParallelWrapper",
     "ParallelInference",
+    "GangSupervisor",
+    "GangFailedError",
     "collectives",
     "launcher",
 ]

@@ -27,8 +27,10 @@ Three pieces:
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
+import re
 import socket
 import subprocess
 import sys
@@ -40,6 +42,8 @@ import numpy as np
 
 from .collectives import Collectives
 
+log = logging.getLogger(__name__)
+
 _ENV_COORD = "TDL_COORDINATOR"
 _ENV_NPROC = "TDL_NUM_PROCESSES"
 _ENV_PID = "TDL_PROCESS_ID"
@@ -48,9 +52,36 @@ _ENV_PLATFORM = "TDL_PLATFORM"
 
 
 def free_port() -> int:
+    """Best-effort free port. Inherently TOCTOU: the socket closes before the
+    coordinator binds, so a concurrent process can steal the port in the gap —
+    callers must treat a coordinator bind failure as retryable
+    (:func:`launch` and ``GangSupervisor`` respawn on a fresh port)."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+# stderr signatures of a coordinator that lost the free_port() race (gRPC
+# server bind) — "respawn the gang on a fresh port". Deliberately NOT the
+# sibling-side symptoms (connect/barrier timeouts): those also fire when a
+# rank dies for unrelated reasons, and a true port race always surfaces the
+# bind error on the coordinator rank itself.
+_BIND_FAILURE_RE = re.compile(
+    r"address already in use|failed to bind|bind address|"
+    r"could not start .*coordin",
+    re.IGNORECASE)
+
+
+def coordinator_bind_failed(results: Sequence["WorkerResult"]) -> bool:
+    """True when a gang's failure pattern matches the free_port() TOCTOU:
+    rank 0 (the process hosting the coordination service) exited nonzero
+    with a bind signature on stderr. Only rank 0 counts — a sibling rank
+    failing with its own bind-ish message (e.g. a worker-local HTTP server
+    on a busy port) is a real worker error, and re-running the whole gang
+    on it would re-execute worker side effects just to hit it again."""
+    return any(r.rank == 0 and r.returncode != 0
+               and _BIND_FAILURE_RE.search(r.stderr or "")
+               for r in results)
 
 
 def initialize(
@@ -161,15 +192,27 @@ def launch(
     extra_env: Optional[Dict[str, str]] = None,
     args: Sequence[str] = (),
     cwd: Optional[str] = None,
+    port_attempts: int = 3,
 ) -> List[WorkerResult]:
     """Spawn ``n_processes`` workers each running ``module:function``.
 
     The worker entry (this module's ``__main__``) calls :func:`initialize`
     from the TDL_* env and then the target function (no arguments; it reads
     ``sys.argv``/env for parameters). Returns once every worker exits.
+
+    A gang that dies with a coordinator bind/connect failure (the
+    ``free_port`` TOCTOU) is respawned on a fresh port up to
+    ``port_attempts`` times before the failing results are returned.
     """
-    procs = spawn(target, n_processes, n_local_devices, platform, extra_env, args, cwd)
-    return wait(procs, timeout=timeout)
+    for attempt in range(max(1, port_attempts)):
+        procs = spawn(target, n_processes, n_local_devices, platform,
+                      extra_env, args, cwd)
+        results = wait(procs, timeout=timeout, abort_on_failure=True)
+        if not coordinator_bind_failed(results) or attempt == port_attempts - 1:
+            return results
+        log.warning("coordinator bind failure (port race); respawning gang "
+                    "on a fresh port (attempt %d/%d)", attempt + 2, port_attempts)
+    return results
 
 
 def spawn(
@@ -180,12 +223,22 @@ def spawn(
     extra_env: Optional[Dict[str, str]] = None,
     args: Sequence[str] = (),
     cwd: Optional[str] = None,
+    port: Optional[int] = None,
+    log_dir: Optional[str] = None,
 ) -> List[subprocess.Popen]:
     """Start the worker processes and return the live Popen handles (the
-    kill-one-process tests need the handles mid-flight)."""
-    port = free_port()
+    kill-one-process tests need the handles mid-flight).
+
+    With ``log_dir`` set, worker stdout/stderr go to ``rank{r}.out/.err``
+    files instead of pipes — required by long-lived monitors (the gang
+    supervisor) that must not drain pipes continuously: an undrained 64KB
+    pipe buffer would block a chatty worker mid-training and masquerade as a
+    hang."""
+    port = port or free_port()
     procs = []
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
     for rank in range(n_processes):
         env = dict(os.environ)
         env.update(extra_env or {})
@@ -195,26 +248,36 @@ def spawn(
         env[_ENV_LOCAL] = str(n_local_devices)
         env[_ENV_PLATFORM] = platform
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-m", "deeplearning4j_tpu.parallel.launcher", target, *args],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-                cwd=cwd or repo_root,
-            )
+        if log_dir:
+            stdout = open(os.path.join(log_dir, f"rank{rank}.out"), "w")
+            stderr = open(os.path.join(log_dir, f"rank{rank}.err"), "w")
+        else:
+            stdout = stderr = subprocess.PIPE
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "deeplearning4j_tpu.parallel.launcher", target, *args],
+            env=env,
+            stdout=stdout,
+            stderr=stderr,
+            text=True,
+            cwd=cwd or repo_root,
         )
+        if log_dir:
+            stdout.close()  # the child holds the fd now
+            stderr.close()
+            proc.tdl_log_paths = (stdout.name, stderr.name)
+        procs.append(proc)
     return procs
 
 
-def wait(procs: List[subprocess.Popen], timeout: float = 600.0) -> List[WorkerResult]:
+def wait(procs: List[subprocess.Popen], timeout: float = 600.0,
+         abort_on_failure: bool = False) -> List[WorkerResult]:
     # drain every pipe CONCURRENTLY: a later rank filling its pipe buffer
     # while an earlier rank blocks in a collective would otherwise deadlock
     # the gang until the timeout kill
     import threading
 
     results: List[Optional[WorkerResult]] = [None] * len(procs)
+    stop = threading.Event()
 
     def drain(rank: int, p: subprocess.Popen):
         try:
@@ -225,12 +288,28 @@ def wait(procs: List[subprocess.Popen], timeout: float = 600.0) -> List[WorkerRe
             err = (err or "") + "\n[launcher] killed after timeout"
         results[rank] = WorkerResult(rank, p.returncode, out or "", err or "")
 
+    def abort_watch():
+        # synchronous SPMD cannot survive a lost member: once any rank dies
+        # nonzero, the survivors are stuck in collectives/connects — kill
+        # them after a short grace instead of burning the full gang timeout
+        while not stop.wait(0.25):
+            codes = [p.poll() for p in procs]
+            if any(c not in (None, 0) for c in codes):
+                stop.wait(5.0)  # grace: let siblings fail on their own terms
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                return
+
     threads = [threading.Thread(target=drain, args=(i, p), daemon=True)
                for i, p in enumerate(procs)]
+    if abort_on_failure:
+        threads.append(threading.Thread(target=abort_watch, daemon=True))
     for t in threads:
         t.start()
-    for t in threads:
+    for t in threads[:len(procs)]:
         t.join(timeout + 30)
+    stop.set()
     return [r if r is not None else WorkerResult(i, -1, "", "[launcher] no result")
             for i, r in enumerate(results)]
 

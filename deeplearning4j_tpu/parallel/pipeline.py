@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common import jax_compat
 from .mesh import AXIS_DATA, AXIS_PIPE
 
 
@@ -115,7 +116,7 @@ def spmd_pipeline(stage_fn: Callable[..., Any], stacked_params, xs, mesh: Mesh,
     xspec = P(None, dp, *([None] * (xs.ndim - 2)))
     aspec = (None if aux is None
              else jax.tree.map(lambda a: P(None, dp, *([None] * (a.ndim - 2))), aux))
-    f = jax.shard_map(
+    f = jax_compat.shard_map(
         functools.partial(_pipeline_body, stage_fn, axis=pipe_axis),
         mesh=mesh, in_specs=(pspec, xspec, aspec), out_specs=xspec,
         check_vma=False,

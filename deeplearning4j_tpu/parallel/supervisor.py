@@ -1,0 +1,388 @@
+"""GangSupervisor — fault-tolerant supervision of a multi-process gang.
+
+The launcher runs a gang exactly once: a worker that crashes or wedges
+inside a gloo/ICI collective stalls every other rank until the timeout kill,
+and recovery is a human re-running the job. The reference stack leans on
+Spark task re-submission for this (SURVEY §3.4, §5.3); the TPU-native
+equivalent — and the cloud-preemption contract the north star requires — is
+gang restart from checkpoint:
+
+- workers write per-rank heartbeat files (iteration + timestamp) from their
+  fit loops (``monitoring.heartbeat``, driven by ``ParallelTrainer`` /
+  ``MetricsListener``);
+- the supervisor polls process liveness and heartbeat freshness; a dead rank
+  or a heartbeat stalled past ``hang_timeout`` condemns the WHOLE gang
+  (synchronous SPMD cannot survive a lost member);
+- the gang is killed (SIGTERM, grace, SIGKILL) and respawned on a **fresh
+  coordinator port** with ``TDL_GANG_RESTART_COUNT`` incremented; worker
+  targets restore from the latest complete checkpoint and replay;
+- restarts are bounded (``max_restarts``) with exponential backoff + jitter;
+- failures are classified: ``crash`` (nonzero exit), ``hang`` (stalled
+  heartbeat), ``bind`` (coordinator port race — retried on its own budget),
+  and repeated crash at the same iteration ⇒ fatal (restarting cannot help a
+  deterministic fault; surface it instead of looping).
+
+Recovery is observable through the PR-1 metrics registry:
+``tdl_worker_deaths_total{reason}``, ``tdl_gang_restarts_total`` and the
+``tdl_gang_recovery_seconds`` histogram (failure detection → gang respawned).
+
+What is deliberately NOT survivable: lost/torn checkpoint shard files (the
+checkpointer refuses partial restores rather than resurrecting zeroed
+weights) and any attempt to patch a single rank back into a live gang —
+mid-collective partial state is unrecoverable by construction.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import subprocess
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..monitoring.heartbeat import ENV_DIR, ENV_INTERVAL, read_heartbeat
+from ..monitoring.registry import MetricsRegistry, get_registry
+from . import launcher
+from .launcher import WorkerResult, _BIND_FAILURE_RE
+
+log = logging.getLogger(__name__)
+
+ENV_INCARNATION = "TDL_GANG_RESTART_COUNT"
+
+
+class GangFailedError(RuntimeError):
+    """The gang could not be driven to completion; carries the supervisor's
+    failure classification and per-rank evidence."""
+
+    def __init__(self, message: str, classification: str,
+                 events: List["GangEvent"]):
+        super().__init__(message)
+        self.classification = classification
+        self.events = events
+
+
+@dataclass
+class GangEvent:
+    """One supervised failure observation (also the metrics evidence)."""
+    time: float                      # time.monotonic at detection
+    reason: str                      # crash | hang | bind | timeout
+    attempt: int                     # spawn attempt the failure happened in
+    ranks: Tuple[int, ...]           # ranks implicated
+    iteration: Optional[int] = None  # last heartbeat iteration of rank[0]
+    detail: str = ""
+
+
+def _supervisor_metrics(registry: MetricsRegistry):
+    return (
+        registry.counter("tdl_worker_deaths_total",
+                         "Supervised worker deaths by failure classification",
+                         labels=("reason",)),
+        registry.counter("tdl_gang_restarts_total",
+                         "Whole-gang restarts performed by GangSupervisor"),
+        registry.histogram("tdl_gang_recovery_seconds",
+                           "Failure detection to gang respawned"),
+    )
+
+
+class GangSupervisor:
+    """Wraps ``launcher.spawn``/``wait`` with heartbeat liveness, whole-gang
+    kill on any member failure, and bounded restart-from-checkpoint.
+
+    The worker target owns the restore: on respawn the supervisor only
+    guarantees a fresh coordinator port and ``TDL_GANG_RESTART_COUNT`` > 0 in
+    the env; targets call ``TrainingCheckpointer.restore`` (or equivalent)
+    unconditionally and continue from whatever ``latest`` holds.
+    """
+
+    def __init__(
+        self,
+        target: str,
+        n_processes: int,
+        n_local_devices: int = 2,
+        platform: str = "cpu",
+        extra_env: Optional[Dict[str, str]] = None,
+        args: Sequence[str] = (),
+        cwd: Optional[str] = None,
+        workdir: Optional[str] = None,
+        max_restarts: int = 3,
+        hang_timeout: float = 60.0,
+        startup_grace: float = 240.0,
+        poll_interval: float = 0.25,
+        heartbeat_interval: Optional[float] = None,
+        backoff_base: float = 0.5,
+        backoff_max: float = 30.0,
+        backoff_jitter: float = 0.25,
+        port_retries: int = 3,
+        kill_grace: float = 5.0,
+        same_iteration_fatal: int = 3,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.target = target
+        self.n_processes = n_processes
+        self.n_local_devices = n_local_devices
+        self.platform = platform
+        self.extra_env = dict(extra_env or {})
+        self.args = tuple(args)
+        self.cwd = cwd
+        import tempfile
+
+        self.workdir = workdir or tempfile.mkdtemp(prefix="tdl_gang_")
+        self.max_restarts = max_restarts
+        self.hang_timeout = hang_timeout
+        self.startup_grace = startup_grace
+        self.poll_interval = poll_interval
+        # default throttles worker beats to a fraction of the hang budget:
+        # liveness resolution is preserved while fast steps aren't taxed
+        # with a write+rename each iteration (0.0 = every iteration,
+        # test-only)
+        self.heartbeat_interval = (min(1.0, hang_timeout / 4.0)
+                                   if heartbeat_interval is None
+                                   else heartbeat_interval)
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.backoff_jitter = backoff_jitter
+        self.port_retries = port_retries
+        self.kill_grace = kill_grace
+        self.same_iteration_fatal = max(2, same_iteration_fatal)
+        self.registry = registry or get_registry()
+        self._deaths, self._restarts_ctr, self._recovery_hist = (
+            _supervisor_metrics(self.registry))
+
+        self.events: List[GangEvent] = []
+        self.restarts = 0           # budgeted restarts performed
+        self.port_failures = 0      # bind-race respawns (separate budget)
+        # crash iterations only: which rank died can vary run-to-run (the
+        # injected rank vs a sibling aborted by gloo noticing the dead peer),
+        # but a deterministic fault replays the same ITERATION every time
+        self._crash_history: List[Optional[int]] = []
+
+    # ------------------------------------------------------------------ run
+
+    def run(self, timeout: float = 600.0) -> List[WorkerResult]:
+        """Drive the gang to completion, restarting on failures. Returns the
+        per-rank results of the final (successful) incarnation, or raises
+        :class:`GangFailedError`."""
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        failed_at: Optional[float] = None
+        while True:
+            procs, hb_dir = self._spawn(attempt)
+            if failed_at is not None:  # time-to-recovery: detection → respawned
+                self._recovery_hist.observe(time.monotonic() - failed_at)
+                failed_at = None
+            failure = self._monitor(procs, hb_dir, attempt, deadline)
+            if failure is None:
+                return self._collect(procs)
+            self.events.append(failure)
+            self._deaths.labels(failure.reason).inc(len(failure.ranks))
+            self._kill_gang(procs)
+            if failure.reason == "timeout":
+                raise GangFailedError("supervision deadline exceeded",
+                                      "timeout", self.events)
+            self._classify_or_raise(failure)
+            if failure.reason == "bind":
+                self.port_failures += 1
+                if self.port_failures > self.port_retries:
+                    raise GangFailedError(
+                        f"coordinator bind failed {self.port_failures} times",
+                        "bind", self.events)
+            else:
+                if self.restarts >= self.max_restarts:
+                    raise GangFailedError(
+                        f"gang failed ({failure.reason} at iteration "
+                        f"{failure.iteration}, ranks {failure.ranks}) and the "
+                        f"restart budget ({self.max_restarts}) is exhausted",
+                        self._final_classification(failure), self.events)
+                self.restarts += 1
+                self._restarts_ctr.inc()
+                self._backoff(self.restarts)
+            attempt += 1
+            if time.monotonic() >= deadline:
+                raise GangFailedError("supervision deadline exceeded",
+                                      "timeout", self.events)
+            log.warning("gang restart %d (spawn attempt %d) after %s at "
+                        "iteration %s", self.restarts, attempt,
+                        failure.reason, failure.iteration)
+            failed_at = failure.time
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _spawn(self, attempt: int):
+        # per-ATTEMPT dirs keep heartbeats/logs of a bind-race respawn from
+        # colliding, but the worker-visible restart count is only the
+        # BUDGETED restarts: a bind respawn never recovered from a failure,
+        # so workers (and incarnation-gated fault clauses) must not see it
+        hb_dir = os.path.join(self.workdir, f"hb_{attempt}")
+        log_dir = os.path.join(self.workdir, f"logs_{attempt}")
+        os.makedirs(hb_dir, exist_ok=True)
+        env = dict(self.extra_env)
+        env[ENV_INCARNATION] = str(self.restarts)
+        env[ENV_DIR] = hb_dir
+        env[ENV_INTERVAL] = str(self.heartbeat_interval)
+        procs = launcher.spawn(
+            self.target, self.n_processes, self.n_local_devices,
+            self.platform, extra_env=env, args=self.args, cwd=self.cwd,
+            log_dir=log_dir)  # fresh free_port() per incarnation
+        return procs, hb_dir
+
+    def _monitor(self, procs, hb_dir: str, attempt: int,
+                 deadline: float) -> Optional[GangEvent]:
+        """Poll liveness + heartbeats until the gang finishes or fails.
+        Returns None on clean completion, else the failure event."""
+        spawned = time.monotonic()
+        # rank → (iteration, mtime, monotonic time the pair last changed)
+        last_progress: Dict[int, Tuple[Optional[int], float, float]] = {}
+        # rank → iteration of its FIRST beat: the fit loop beats before the
+        # step runs, so the stall between the first beat and the first
+        # iteration ADVANCE is the first XLA compile — budget it with
+        # startup_grace, not hang_timeout
+        first_iter: Dict[int, Optional[int]] = {}
+        while True:
+            now = time.monotonic()
+            codes = [p.poll() for p in procs]
+            dead = [r for r, c in enumerate(codes) if c not in (None, 0)]
+            if dead:
+                iters = [self._hb_iter(hb_dir, r) for r in dead]
+                reason = "bind" if self._bind_failure(procs, dead) else "crash"
+                return GangEvent(now, reason, attempt, tuple(dead),
+                                 iters[0],
+                                 detail=f"exit codes {[codes[r] for r in dead]}")
+            if all(c == 0 for c in codes):
+                return None
+            hung = []
+            for rank, c in enumerate(codes):
+                if c == 0:
+                    continue  # finished ranks are allowed to go quiet
+                hb = read_heartbeat(hb_dir, rank)
+                if hb is None:
+                    # no beat yet: startup (imports + first compile) gets its
+                    # own, larger grace window
+                    if now - spawned > self.startup_grace:
+                        hung.append(rank)
+                    continue
+                it, mtime = hb
+                if rank not in first_iter:
+                    first_iter[rank] = it
+                prev = last_progress.get(rank)
+                if prev is None or (it, mtime) != prev[:2]:
+                    last_progress[rank] = (it, mtime, now)
+                    continue
+                stall_budget = (self.startup_grace
+                                if it == first_iter[rank] else
+                                self.hang_timeout)
+                if now - prev[2] > stall_budget:
+                    hung.append(rank)
+            if hung:
+                it = self._hb_iter(hb_dir, hung[0])
+                if it is None:  # condemned via the startup-grace path
+                    detail = (f"no heartbeat at all within startup grace "
+                              f"({self.startup_grace}s) — wedged before the "
+                              f"fit loop (imports / first compile?)")
+                elif it == first_iter.get(hung[0]):
+                    detail = (f"heartbeat never advanced past its first "
+                              f"iteration ({it}) within startup grace "
+                              f"({self.startup_grace}s) — wedged in the "
+                              f"first step (compile?)")
+                else:
+                    detail = (f"no heartbeat progress for "
+                              f">{self.hang_timeout}s")
+                return GangEvent(now, "hang", attempt, tuple(hung), it,
+                                 detail=detail)
+            if now >= deadline:
+                return GangEvent(now, "timeout", attempt,
+                                 tuple(r for r, c in enumerate(codes)
+                                       if c is None),
+                                 self._hb_iter(hb_dir, 0),
+                                 detail="supervision deadline exceeded")
+            time.sleep(self.poll_interval)
+
+    def _kill_gang(self, procs) -> None:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:  # already reaped
+                    log.debug("SIGTERM race on pid %s", p.pid)
+        t0 = time.monotonic()
+        while (time.monotonic() - t0 < self.kill_grace
+               and any(p.poll() is None for p in procs)):
+            time.sleep(0.05)
+        for p in procs:
+            if p.poll() is None:
+                # SIGTERM cannot help a rank wedged in a native collective —
+                # the Python handler never runs while C++ holds the thread
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                log.warning("worker pid %s survived SIGKILL wait", p.pid)
+
+    def _collect(self, procs) -> List[WorkerResult]:
+        results = []
+        for rank, p in enumerate(procs):
+            out = err = ""
+            paths = getattr(p, "tdl_log_paths", None)
+            if paths:
+                for i, path in enumerate(paths):
+                    try:
+                        with open(path) as f:
+                            text = f.read()
+                    except OSError:
+                        text = ""
+                    if i == 0:
+                        out = text
+                    else:
+                        err = text
+            results.append(WorkerResult(rank, p.returncode, out, err))
+        return results
+
+    # -------------------------------------------------------- classification
+
+    def _bind_failure(self, procs, dead_ranks) -> bool:
+        # only rank 0 hosts the coordination service; bind-ish stderr on any
+        # other rank is that worker's own failure (see
+        # launcher.coordinator_bind_failed)
+        if 0 not in dead_ranks:
+            return False
+        paths = getattr(procs[0], "tdl_log_paths", None)
+        if not paths:
+            return False
+        try:
+            with open(paths[1]) as f:
+                return bool(_BIND_FAILURE_RE.search(f.read()))
+        except OSError:
+            return False
+
+    def _hb_iter(self, hb_dir: str, rank: int) -> Optional[int]:
+        hb = read_heartbeat(hb_dir, rank)
+        return hb[0] if hb else None
+
+    def _classify_or_raise(self, failure: GangEvent) -> None:
+        """Repeated crash at the same (ranks, iteration) is deterministic —
+        restarting cannot help; surface it instead of burning the budget."""
+        if failure.reason != "crash":
+            return
+        self._crash_history.append(failure.iteration)
+        if failure.iteration is None:
+            return
+        repeats = self._crash_history.count(failure.iteration)
+        if repeats >= self.same_iteration_fatal:
+            raise GangFailedError(
+                f"rank(s) {failure.ranks} crashed {repeats}x at iteration "
+                f"{failure.iteration} — deterministic fault, not restarting",
+                "repeated_crash_same_iteration", self.events)
+
+    def _final_classification(self, failure: GangEvent) -> str:
+        if (failure.reason == "crash" and failure.iteration is not None
+                and self._crash_history.count(failure.iteration) >= 2):
+            return "repeated_crash_same_iteration"
+        return failure.reason
+
+    def _backoff(self, attempt: int) -> None:
+        delay = min(self.backoff_max, self.backoff_base * (2 ** (attempt - 1)))
+        delay *= 1.0 + self.backoff_jitter * random.random()
+        time.sleep(delay)

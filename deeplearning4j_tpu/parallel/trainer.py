@@ -27,7 +27,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common import faults
 from ..data.dataset import DataSet
+from ..monitoring import heartbeat
 from ..monitoring.registry import get_registry
 from .mesh import AXIS_DATA, build_mesh
 
@@ -140,6 +142,12 @@ class ParallelTrainer:
         self._fit_core(ds)
 
     def _fit_core(self, ds: DataSet):
+        # gang-supervision hooks (no-ops unless the TDL_HEARTBEAT_DIR /
+        # TDL_FAULT_SPEC env contracts are active): heartbeat FIRST so a
+        # crash/hang injected at iteration k is attributed to k
+        it = int(self.net.iteration)
+        heartbeat.maybe_beat(it)
+        faults.fault_point("train_step", iteration=it)
         t0 = time.perf_counter()
         self._fit_core_inner(ds)
         self._step_hist.labels(self._trainer_label).observe(time.perf_counter() - t0)

@@ -23,12 +23,18 @@ safety did not exist. TPU-native shape:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import signal
 import threading
 from typing import Any, Dict, Optional
 
 import numpy as np
+
+from ..common import faults
+from ..monitoring.registry import get_registry
+
+log = logging.getLogger(__name__)
 
 _STATE_FILE = "train_state.json"
 
@@ -106,6 +112,12 @@ class TrainingCheckpointer:
         self.dir = directory
         self.async_write = async_write
         self._writer: Optional[threading.Thread] = None
+        # a failed async write must not vanish on the background thread: it
+        # is captured here and re-raised from wait() / the next save()
+        self._error: Optional[BaseException] = None
+        self._failures = get_registry().counter(
+            "tdl_checkpoint_failures_total",
+            "Checkpoint writes that raised (sync or async)")
         os.makedirs(directory, exist_ok=True)
 
     # ------------------------------------------------------------------ save
@@ -131,6 +143,7 @@ class TrainingCheckpointer:
             meta["iterator"] = iterator.state()
 
         def write():
+            faults.fault_point("ckpt_write")  # chaos: slow_ckpt_io=<seconds>
             # the save id (the iteration — identical on every process of a
             # synchronous SPMD run) is stamped into every shard AND the meta
             # file; restore refuses mismatches, so a kill between the two
@@ -153,21 +166,39 @@ class TrainingCheckpointer:
                     json.dump(meta, f)
                 os.replace(tmp_m, os.path.join(ckdir, _STATE_FILE))
 
-        self.wait()  # one in-flight write at a time
+        def async_guarded_write():
+            try:
+                write()
+            except BaseException as e:  # captured, re-raised at wait()/save()
+                self._failures.inc()
+                log.error("async checkpoint write to %s failed: %s", ckdir, e)
+                self._error = e
+
+        self.wait()  # one in-flight write at a time; raises a pending failure
         if self.async_write:
             # non-daemon: a clean interpreter exit drains the write instead
             # of silently discarding a checkpoint save() already returned for
-            self._writer = threading.Thread(target=write, daemon=False)
+            self._writer = threading.Thread(target=async_guarded_write,
+                                            daemon=False)
             self._writer.start()
         else:
-            write()
+            try:
+                write()
+            except BaseException:
+                self._failures.inc()
+                raise
         return ckdir
 
     def wait(self):
-        """Block until the in-flight async write (if any) is durable."""
+        """Block until the in-flight async write (if any) is durable. If the
+        write failed on the background thread, re-raise its exception here —
+        callers must not believe a checkpoint exists when it doesn't."""
         if self._writer is not None:
             self._writer.join()
             self._writer = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
     # --------------------------------------------------------------- restore
 

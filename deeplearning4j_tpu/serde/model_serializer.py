@@ -16,11 +16,14 @@ from __future__ import annotations
 
 import io
 import json
+import logging
 import os
 import zipfile
 from typing import Optional
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 
 def _flatten_tree(tree, prefix=""):
@@ -180,8 +183,9 @@ class ModelGuesser:
                 return TFGraphMapper.import_frozen_graph(path)
             except TFImportError:
                 raise  # real GraphDef with unsupported ops: surface that
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("frozen-GraphDef parse of %s failed (%s); "
+                          "falling through to 'cannot guess'", path, e)
         raise ValueError(
             f"cannot guess model format of {path}: not a ModelSerializer "
             "zip, Keras HDF5, or frozen TF GraphDef")

@@ -50,12 +50,17 @@ class JsonModelServer:
         def build(self) -> "JsonModelServer":
             return JsonModelServer(self._model, **self._kw)
 
-    def _predict(self, payload: Any) -> Any:
-        x = self.deserializer(payload)
+    def _deserialize(self, payload: Any) -> np.ndarray:
+        return self.deserializer(payload)
+
+    def _infer(self, x: np.ndarray) -> Any:
         with self._lock:  # model state is not re-entrant under donation
             out = self.model.output(x)
         arr = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
         return self.serializer(arr)
+
+    def _predict(self, payload: Any) -> Any:
+        return self._infer(self._deserialize(payload))
 
     def start(self) -> "JsonModelServer":
         server = self
@@ -76,12 +81,20 @@ class JsonModelServer:
                 if self.path != server.endpoint:
                     self._json({"error": "unknown endpoint"}, 404)
                     return
+                # 400 = the CALLER's fault (malformed JSON / undecodable
+                # payload); 500 = OUR fault (model raised) — clients retry
+                # 5xx against a replica but must not retry a bad payload
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length))
-                    self._json({"output": server._predict(payload)})
-                except Exception as e:  # serving endpoint must not die
+                    x = server._deserialize(payload)
+                except Exception as e:
                     self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+                    return
+                try:  # serving endpoint must not die on a model failure
+                    self._json({"output": server._infer(x)})
+                except Exception as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 
             def do_GET(self):
                 if self.path == "/health":
@@ -108,13 +121,24 @@ class JsonModelClient:
         self.url = f"http://{host}:{port}{endpoint}"
 
     def predict(self, data) -> Any:
+        import urllib.error
         import urllib.request
 
         body = json.dumps(np.asarray(data).tolist()).encode()
         req = urllib.request.Request(self.url, data=body,
                                      headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            out = json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # non-2xx raises BEFORE the structured error body is read —
+            # surface the server's JSON error, not a bare "HTTP Error 400"
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:
+                detail = ""
+            raise RuntimeError(
+                f"server returned HTTP {e.code}: {detail or e.reason}") from None
         if "error" in out:
             raise RuntimeError(out["error"])
         return out["output"]

@@ -176,6 +176,54 @@ def ckpt_train():
                   "start": start})
 
 
+def supervised_train():
+    """GangSupervisor worker target: data-parallel training with SHARDED
+    checkpoints (``TrainingCheckpointer``) every TDL_MP_CKPT_EVERY steps and
+    an unconditional restore-from-latest on start — the supervisor restart
+    contract. Heartbeats and fault injection ride the real
+    ``ParallelTrainer._fit_core`` hooks (TDL_HEARTBEAT_DIR / TDL_FAULT_SPEC
+    env, set by the supervisor / the chaos test)."""
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.trainer import MultiProcessTrainer
+    from deeplearning4j_tpu.serde.checkpoint import TrainingCheckpointer
+
+    col = ProcessCollectives()
+    rank, world = col.rank, col.world
+    total_steps = int(os.environ.get("TDL_MP_STEPS", "10"))
+    every = int(os.environ.get("TDL_MP_CKPT_EVERY", "2"))
+    incarnation = int(os.environ.get("TDL_GANG_RESTART_COUNT", "0"))
+
+    net = _toy_net()
+    ck = TrainingCheckpointer(os.environ["TDL_MP_CKPT"], async_write=False)
+    start = 0
+    if ck.restore(net):  # empty dir on incarnation 0 → False
+        start = int(net.iteration)
+    trainer = MultiProcessTrainer(net, build_mesh(data=-1))
+    losses = []
+    for step in range(start, total_steps):
+        x, y = _global_batch(step)
+        lo = rank * (len(x) // world)
+        hi = lo + len(x) // world
+        trainer.fit([DataSet(x[lo:hi], y[lo:hi])])
+        losses.append(net.score_)
+        if (step + 1) % every == 0:
+            # all ranks at the same iteration before anyone writes a shard
+            col.barrier(f"ck-{step}")
+            ck.save(net)
+            col.barrier(f"ck-done-{step}")
+
+    flat = np.asarray(net.params().numpy(), np.float64)
+    _write(rank, {"losses": [float(l) for l in losses],
+                  "param_sum": float(flat.sum()),
+                  "param_norm": float(np.linalg.norm(flat)),
+                  "start": start, "incarnation": incarnation,
+                  "global_devices": jax.device_count()})
+
+
 def w2v_shard_train():
     """Cross-process embedding-shard training (SURVEY §2.2 J17 / §2.6 S6):
     syn0/syn1 rows shard over a GLOBAL mesh spanning both processes; the
@@ -257,6 +305,8 @@ def tp_step_losses(mesh, steps=3):
     """Shared by the worker and the parent's single-process reference:
     deterministic dp×tp transformer training losses on the given mesh."""
     import jax
+
+    from deeplearning4j_tpu.common import jax_compat
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
     from jax.tree_util import tree_map
@@ -300,7 +350,7 @@ def tp_step_losses(mesh, steps=3):
 
     rng = jax.random.wrap_key_data(_rep_arr(jax.random.key_data(jax.random.key(9))))
     losses = []
-    with jax.sharding.set_mesh(mesh):
+    with jax_compat.set_mesh(mesh):
         for i in range(steps):
             it = _rep_arr(np.asarray(i, np.int32))
             params, opt, loss = step(params, opt, batch, it, rng)

@@ -101,6 +101,47 @@ class TestCheckpointer:
             resumed.append(b.score_)
         np.testing.assert_allclose(resumed, ref_losses[4:], rtol=1e-5, atol=1e-6)
 
+    def test_async_write_failure_surfaces(self, tmp_path, monkeypatch):
+        """ISSUE 3 satellite: a failed background write must not vanish —
+        it re-raises from wait() (or the next save()) and counts
+        tdl_checkpoint_failures_total."""
+        import numpy as _np
+
+        from deeplearning4j_tpu.monitoring.registry import get_registry
+
+        failures = get_registry().counter("tdl_checkpoint_failures_total")
+        before = failures.value
+        net = _net()
+        ck = TrainingCheckpointer(str(tmp_path), async_write=True)
+
+        real_savez = _np.savez
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(_np, "savez", boom)
+        ck.save(net)  # background thread hits the failing write
+        with pytest.raises(OSError, match="disk full"):
+            ck.wait()
+        assert failures.value == before + 1
+
+        # the error is consumed once surfaced; a healthy save works again
+        monkeypatch.setattr(_np, "savez", real_savez)
+        ck.save(net)
+        ck.wait()
+        assert os.path.exists(tmp_path / "latest" / "shard_0.npz")
+
+    def test_async_write_failure_reraised_by_next_save(self, tmp_path, monkeypatch):
+        import numpy as _np
+
+        net = _net()
+        ck = TrainingCheckpointer(str(tmp_path), async_write=True)
+        monkeypatch.setattr(_np, "savez",
+                            lambda *a, **k: (_ for _ in ()).throw(OSError("enospc")))
+        ck.save(net)
+        with pytest.raises(OSError, match="enospc"):
+            ck.save(net)
+
     def test_sharded_arrays_roundtrip_over_mesh(self, tmp_path):
         """Params sharded over the 8-device mesh save shard-wise and
         reassemble to the same global values."""

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deeplearning4j_tpu.common import jax_compat
 from deeplearning4j_tpu.kernels import flash_attention, mha_reference, ring_attention
 
 
@@ -37,7 +38,7 @@ def test_ring_attention_matches_reference(causal):
     q, k, v = _qkv()
     ref = mha_reference(q, k, v, causal=causal)
     mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
-    f = jax.shard_map(
+    f = jax_compat.shard_map(
         lambda a, b, c: ring_attention(a, b, c, axis_name="sp", causal=causal),
         mesh=mesh,
         in_specs=(P(None, None, "sp", None),) * 3,
@@ -222,7 +223,7 @@ def test_ulysses_attention_matches_reference(causal):
     q, k, v = _qkv((2, 4, 256, 32))
     ref = mha_reference(q, k, v, causal=causal)
     mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
-    f = jax.shard_map(
+    f = jax_compat.shard_map(
         lambda a, b, c: ulysses_attention(a, b, c, axis_name="sp", causal=causal),
         mesh=mesh,
         in_specs=(P(None, None, "sp", None),) * 3,
@@ -240,7 +241,7 @@ def test_ulysses_attention_respects_key_mask():
     mask = jnp.asarray((rs.rand(2, 64) > 0.3).astype(np.float32))
     ref = mha_reference(q, k, v, mask)
     mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
-    f = jax.shard_map(
+    f = jax_compat.shard_map(
         lambda a, b, c, m: ulysses_attention(a, b, c, axis_name="sp", key_mask=m),
         mesh=mesh,
         in_specs=(P(None, None, "sp", None),) * 3 + (P(None, "sp"),),
@@ -255,7 +256,7 @@ def test_ulysses_heads_divisibility_error():
 
     q, k, v = _qkv((1, 3, 64, 16))  # 3 heads, 4 devices
     mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
-    f = jax.shard_map(
+    f = jax_compat.shard_map(
         lambda a, b, c: ulysses_attention(a, b, c, axis_name="sp"),
         mesh=mesh, in_specs=(P(None, None, "sp", None),) * 3,
         out_specs=P(None, None, "sp", None),

@@ -436,3 +436,44 @@ def test_no_bare_print_in_library_code():
     assert not offenders, (
         "bare print() in library code (use logging or the metrics "
         f"registry): {offenders}")
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:`` or ``except (Base)Exception`` — the handlers that can
+    swallow genuine bugs. Narrow handlers (``except (TypeError, ValueError)``)
+    may legitimately pass: dropping unparseable rows IS their semantics."""
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("Exception", "BaseException"):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def test_no_silent_exception_swallowing():
+    """Repo lint (ISSUE 3 satellite): a broad except whose entire body is
+    ``pass``/``...`` silently swallows bugs — library code must log (even at
+    debug level), narrow the exception, or actually handle it."""
+    root = pathlib.Path(__file__).resolve().parent.parent / "deeplearning4j_tpu"
+    offenders = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        tree = ast.parse(path.read_text(), filename=rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            body = node.body
+            only_pass = len(body) == 1 and (
+                isinstance(body[0], ast.Pass)
+                or (isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and body[0].value.value is Ellipsis))
+            if only_pass and _broad_handler(node):
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "silent broad exception swallowing in library code (log it, narrow "
+        f"it, or handle it): {offenders}")

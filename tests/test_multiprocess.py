@@ -9,7 +9,10 @@ own jax runtime), connect them through the PJRT distributed coordinator
 - MultiProcessTrainer data-parallel training matches a single-process run,
 - EncodedGradientsAccumulator exchanges encoded gradients between processes,
 - kill-one-process → restore-from-checkpoint reproduces the uninterrupted
-  run (SURVEY §5.3 preemption story).
+  run (SURVEY §5.3 preemption story). The MANUAL restart here pins the
+  checkpoint semantics; the unattended version — GangSupervisor detects the
+  death, kills the gang, and respawns it from `latest` itself — lives in
+  test_supervisor.py (ISSUE 3 graduation of this test).
 
 Analog of the reference's local[N] Spark + DummyTransport tiers (SURVEY
 §4.4), upgraded to real processes.
@@ -93,6 +96,8 @@ def test_encoded_gradient_exchange_across_processes(tmp_path):
 
 
 def test_kill_one_process_restore_from_checkpoint(tmp_path):
+    # manual-restart half of the preemption contract; the supervised
+    # (unattended) half is test_supervisor.test_supervisor_recovers_from_injected_crash
     steps, die_at = 8, 4
     base_env = {"TDL_MP_OUT": str(tmp_path / "a.json"),
                 "TDL_MP_CKPT": str(tmp_path / "ckpt_a"),

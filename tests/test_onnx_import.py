@@ -284,6 +284,35 @@ class TestR5ReviewFixes:
         x = np.arange(8, dtype=np.float32).reshape(2, 4)
         np.testing.assert_array_equal(g.output({"x": x})["out"], x[:, ::-1])
 
+    def test_negative_step_slice_end_clamp(self):
+        """ADVICE r5: starts=-1, ends=2, steps=-1 on a length-5 axis must
+        yield [4, 3] — the old clamp wrapped NON-negative ends by +n and
+        produced an empty slice."""
+        nodes = [node("Slice", ["x", "st", "en", "ax", "sp"], ["out"])]
+        inits = [t_proto("st", np.array([-1], np.int64)),
+                 t_proto("en", np.array([2], np.int64)),
+                 t_proto("ax", np.array([0], np.int64)),
+                 t_proto("sp", np.array([-1], np.int64))]
+        mb = model(nodes, inits, [value_info("x", (5,))], ["out"])
+        g = OnnxGraphMapper.import_model(mb)
+        x = np.arange(5, dtype=np.float32)
+        np.testing.assert_array_equal(g.output({"x": x})["out"], x[-1:2:-1])
+
+    def test_negative_step_slice_torch_export_shape(self):
+        """The torch ``x[4:1:-1]`` export (positive start AND end with a
+        negative step) keeps its length-3 result."""
+        nodes = [node("Slice", ["x", "st", "en", "ax", "sp"], ["out"])]
+        inits = [t_proto("st", np.array([4], np.int64)),
+                 t_proto("en", np.array([1], np.int64)),
+                 t_proto("ax", np.array([0], np.int64)),
+                 t_proto("sp", np.array([-1], np.int64))]
+        mb = model(nodes, inits, [value_info("x", (5, 2))], ["out"])
+        g = OnnxGraphMapper.import_model(mb)
+        x = np.arange(10, dtype=np.float32).reshape(5, 2)
+        got = g.output({"x": x})["out"]
+        assert got.shape == (3, 2)
+        np.testing.assert_array_equal(got, x[4:1:-1])
+
     def test_colon_in_tensor_names(self):
         """tf2onnx keeps 'scope/op:0' names; lookups must be exact."""
         nodes = [node("Relu", ["model/dense/BiasAdd:0"], ["model/out:0"])]

@@ -1,0 +1,263 @@
+"""Gang supervision chaos tests (ISSUE 3 tentpole acceptance).
+
+The fault injector (``TDL_FAULT_SPEC``) drives deterministic crashes/hangs
+through the REAL recovery path: heartbeat files from ``ParallelTrainer``,
+liveness polling in ``GangSupervisor``, whole-gang kill, respawn on a fresh
+coordinator port, restore from the latest sharded checkpoint. The graduation
+of ``test_kill_one_process_restore_from_checkpoint``: the supervisor
+reproduces the run unattended.
+
+Fast unit tests for the fault-spec grammar, heartbeat files, bind-failure
+classification and launch port-retry live here too.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import faults
+from deeplearning4j_tpu.common.faults import FaultInjector, parse_fault_spec
+from deeplearning4j_tpu.monitoring.heartbeat import (HeartbeatWriter,
+                                                     read_heartbeat)
+from deeplearning4j_tpu.monitoring.registry import MetricsRegistry
+from deeplearning4j_tpu.parallel import GangFailedError, GangSupervisor, launcher
+
+WORKERS = os.path.join(os.path.dirname(__file__), "mp_workers.py")
+
+
+# ------------------------------------------------------------------ fault spec
+
+
+def test_fault_spec_parsing():
+    fs = parse_fault_spec("crash@iter=7,rank=1;hang@iter=5,rank=0;slow_ckpt_io=2.0")
+    assert [f.kind for f in fs] == ["crash", "hang", "slow_ckpt_io"]
+    assert fs[0].iteration == 7 and fs[0].rank == 1
+    assert fs[1].iteration == 5 and fs[1].rank == 0
+    assert fs[2].value == 2.0
+    assert parse_fault_spec("") == []
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        parse_fault_spec("explode@iter=1")
+    with pytest.raises(ValueError, match="bad fault param"):
+        parse_fault_spec("crash@iter")
+
+
+def test_fault_incarnation_gating():
+    f = parse_fault_spec("crash@iter=3,rank=0")[0]
+    assert f.fires_in_incarnation(0) and not f.fires_in_incarnation(1)
+    f = parse_fault_spec("crash@iter=3,every=1")[0]
+    assert f.fires_in_incarnation(0) and f.fires_in_incarnation(7)
+    f = parse_fault_spec("crash@iter=3,restart=2")[0]
+    assert f.fires_in_incarnation(2) and not f.fires_in_incarnation(0)
+
+
+def test_fault_injector_rank_and_iteration_match():
+    inj = FaultInjector(parse_fault_spec("crash@iter=7,rank=1"), rank=0,
+                        incarnation=0)
+    inj.fire("train_step", iteration=7)  # wrong rank: no crash
+    inj = FaultInjector(parse_fault_spec("crash@iter=7,rank=1"), rank=1,
+                        incarnation=1)
+    inj.fire("train_step", iteration=7)  # wrong incarnation: no crash
+
+
+def test_fault_point_slow_ckpt_io(monkeypatch):
+    monkeypatch.setenv(faults.ENV_SPEC, "slow_ckpt_io=0.15")
+    t0 = time.perf_counter()
+    faults.fault_point("ckpt_write")
+    assert time.perf_counter() - t0 >= 0.15
+    t0 = time.perf_counter()
+    faults.fault_point("train_step", iteration=3)  # site mismatch: no sleep
+    assert time.perf_counter() - t0 < 0.1
+
+
+# ------------------------------------------------------------------ heartbeats
+
+
+def test_heartbeat_write_read_roundtrip(tmp_path):
+    w = HeartbeatWriter(str(tmp_path), rank=3, interval=0.0)
+    assert read_heartbeat(str(tmp_path), 3) is None
+    assert w.beat(5)
+    it, mtime = read_heartbeat(str(tmp_path), 3)
+    assert it == 5 and mtime > 0
+    assert w.beat(6)
+    assert read_heartbeat(str(tmp_path), 3)[0] == 6
+
+
+def test_heartbeat_throttle(tmp_path):
+    w = HeartbeatWriter(str(tmp_path), rank=0, interval=60.0)
+    assert w.beat(1)           # first beat always writes
+    assert not w.beat(2)       # throttled
+    assert w.iteration == 2    # in-memory progress still tracked
+    assert read_heartbeat(str(tmp_path), 0)[0] == 1
+
+
+def test_maybe_beat_env_contract(tmp_path, monkeypatch):
+    from deeplearning4j_tpu.monitoring import heartbeat as hb
+
+    monkeypatch.delenv(hb.ENV_DIR, raising=False)
+    monkeypatch.setattr(hb, "_writer", None)
+    hb.maybe_beat(1)  # no dir: no-op, no writer created
+    assert hb._writer is None
+    monkeypatch.setenv(hb.ENV_DIR, str(tmp_path))
+    monkeypatch.setenv(hb.ENV_INTERVAL, "0")
+    monkeypatch.setenv(hb.ENV_RANK, "2")
+    hb.maybe_beat(9)
+    assert read_heartbeat(str(tmp_path), 2)[0] == 9
+
+
+# ------------------------------------------- port TOCTOU / bind classification
+
+
+def test_coordinator_bind_failure_classifier():
+    ok = launcher.WorkerResult(0, 0, "", "Address already in use")  # rc 0
+    crash = launcher.WorkerResult(0, 1, "", "ValueError: bad batch")
+    bind = launcher.WorkerResult(0, 1, "", "RuntimeError: Failed to bind "
+                                           "address 127.0.0.1:12345")
+    # bind-ish stderr on a NON-coordinator rank is that worker's own failure
+    # (e.g. its local HTTP server port) — must NOT classify as the TOCTOU
+    sibling = launcher.WorkerResult(1, 1, "", "UNKNOWN: Address already in use")
+    assert not launcher.coordinator_bind_failed([ok])
+    assert not launcher.coordinator_bind_failed([crash])
+    assert launcher.coordinator_bind_failed([bind])
+    assert not launcher.coordinator_bind_failed([ok, sibling])
+    assert launcher.coordinator_bind_failed([bind, sibling])
+
+
+def test_launch_retries_on_bind_failure(monkeypatch):
+    spawns = []
+
+    def fake_spawn(*a, **k):
+        spawns.append(1)
+        return ["proc"]
+
+    def fake_wait(procs, timeout=600.0, abort_on_failure=False):
+        if len(spawns) == 1:
+            return [launcher.WorkerResult(
+                0, 1, "", "RuntimeError: Failed to bind address")]
+        return [launcher.WorkerResult(0, 0, "done", "")]
+
+    monkeypatch.setattr(launcher, "spawn", fake_spawn)
+    monkeypatch.setattr(launcher, "wait", fake_wait)
+    results = launcher.launch("m:f", n_processes=1)
+    assert len(spawns) == 2  # fresh free_port() inside the second spawn
+    assert results[0].returncode == 0
+
+
+# ------------------------------------------------------------------ chaos runs
+# Full-gang chaos runs spawn real 2-process jax gangs several times over
+# (~20s each) — slow-marked like the rest of the long multiprocess tier;
+# run explicitly with `pytest tests/test_supervisor.py -m slow`.
+
+
+def _reference_params(steps):
+    """Single-process uninterrupted run on the same deterministic batches —
+    the ground truth the supervised (crashed + restarted) gang must match."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from tests.mp_workers import _global_batch, _toy_net
+
+    net = _toy_net()
+    for step in range(steps):
+        x, y = _global_batch(step)
+        net.fit(DataSet(x, y))
+    flat = np.asarray(net.params().numpy(), np.float64)
+    return float(flat.sum()), float(np.linalg.norm(flat))
+
+
+def _supervisor(tmp_path, fault_spec, steps, every=2, **kw):
+    out = str(tmp_path / "out.json")
+    env = {"TDL_MP_OUT": out,
+           "TDL_MP_CKPT": str(tmp_path / "ckpt"),
+           "TDL_MP_STEPS": str(steps),
+           "TDL_MP_CKPT_EVERY": str(every),
+           "TDL_MATMUL_PRECISION": "float32"}
+    if fault_spec:
+        env["TDL_FAULT_SPEC"] = fault_spec
+    os.makedirs(env["TDL_MP_CKPT"], exist_ok=True)
+    registry = MetricsRegistry()
+    kw.setdefault("hang_timeout", 60.0)
+    kw.setdefault("startup_grace", 300.0)
+    sup = GangSupervisor(f"{WORKERS}:supervised_train", n_processes=2,
+                         n_local_devices=2, extra_env=env,
+                         workdir=str(tmp_path / "gang"),
+                         heartbeat_interval=0.0, backoff_base=0.1,
+                         kill_grace=1.0, registry=registry, **kw)
+    return sup, out, registry
+
+
+@pytest.mark.slow
+def test_supervisor_recovers_from_injected_crash(tmp_path):
+    """Acceptance: TDL_FAULT_SPEC=crash@iter=7,rank=1 → the supervisor
+    completes training unattended with ≥1 restart in tdl_gang_restarts_total
+    and final params matching the fault-free run."""
+    steps = 10
+    sup, out, reg = _supervisor(tmp_path, "crash@iter=7,rank=1", steps,
+                                max_restarts=3)
+    results = sup.run(timeout=540.0)
+    for r in results:
+        assert r.returncode == 0, f"rank {r.rank} failed:\n{r.stderr[-3000:]}"
+
+    assert sup.restarts >= 1
+    assert reg.get("tdl_gang_restarts_total").value >= 1
+    assert reg.get("tdl_worker_deaths_total").labels("crash").value >= 1
+    assert reg.get("tdl_gang_recovery_seconds").snapshot()["series"][0]["count"] >= 1
+
+    crash_events = [e for e in sup.events if e.reason == "crash"]
+    assert crash_events and 1 in crash_events[0].ranks
+    assert crash_events[0].iteration == 7  # heartbeat attributed the death
+
+    with open(out + ".rank0") as f:
+        r0 = json.load(f)
+    assert r0["incarnation"] >= 1
+    assert r0["start"] == 6  # ckpt after step 5 survived; crash was at 7
+    ref_sum, ref_norm = _reference_params(steps)
+    np.testing.assert_allclose(r0["param_sum"], ref_sum, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(r0["param_norm"], ref_norm, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_supervisor_detects_hang_well_before_gang_timeout(tmp_path):
+    """A wedged rank (injected hang) stalls its heartbeat; the supervisor
+    kills and restarts the gang in ~hang_timeout — two orders of magnitude
+    under the 600s gang timeout the launcher alone would burn."""
+    steps = 8
+    hang_timeout = 8.0
+    sup, out, reg = _supervisor(tmp_path, "hang@iter=5,rank=0", steps,
+                                max_restarts=2, hang_timeout=hang_timeout)
+    t0 = time.monotonic()
+    results = sup.run(timeout=540.0)
+    elapsed = time.monotonic() - t0
+    for r in results:
+        assert r.returncode == 0, f"rank {r.rank} failed:\n{r.stderr[-3000:]}"
+
+    hang_events = [e for e in sup.events if e.reason == "hang"]
+    assert hang_events, [e.reason for e in sup.events]
+    assert 0 in hang_events[0].ranks
+    assert sup.restarts == 1
+    assert reg.get("tdl_worker_deaths_total").labels("hang").value >= 1
+    # the whole supervised run (spawn + train + detect + respawn + finish)
+    # fits in a fraction of the 600s gang timeout
+    assert elapsed < 300.0, elapsed
+
+    with open(out + ".rank0") as f:
+        r0 = json.load(f)
+    assert r0["start"] == 4  # ckpt after step 3; hang froze iteration 5
+    ref_sum, _ = _reference_params(steps)
+    np.testing.assert_allclose(r0["param_sum"], ref_sum, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_repeated_crash_same_iteration_is_fatal(tmp_path):
+    """A deterministic fault (crash at the same iteration every incarnation)
+    must be classified fatal and surfaced — not retried until the restart
+    budget burns down."""
+    sup, out, reg = _supervisor(tmp_path, "crash@iter=3,rank=1,every=1",
+                                steps=6, max_restarts=5,
+                                same_iteration_fatal=2)
+    with pytest.raises(GangFailedError) as ei:
+        sup.run(timeout=540.0)
+    assert ei.value.classification == "repeated_crash_same_iteration"
+    assert sup.restarts < sup.max_restarts  # budget NOT exhausted: classified
+    assert reg.get("tdl_worker_deaths_total").labels("crash").value == 2
+    assert len([e for e in ei.value.events if e.reason == "crash"]) == 2

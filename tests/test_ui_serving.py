@@ -91,6 +91,58 @@ def test_profiling_listener_and_analyzer(tmp_path):
     assert abs(cmp["mean_speedup"] - 1.0) < 1e-9
 
 
+def test_json_server_status_codes_and_client_error_surface():
+    """ISSUE 3 satellite: 400 is reserved for malformed payloads, 500 for
+    internal model failures, and the client surfaces the server's structured
+    JSON error instead of urllib's bare HTTPError."""
+    import urllib.error
+
+    class BoomModel:
+        def output(self, x):
+            raise RuntimeError("updater state poisoned")
+
+    server = JsonModelServer(BoomModel()).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/predict"
+
+        # malformed JSON body → 400 with a structured error
+        req = urllib.request.Request(
+            url, data=b"{not json", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
+
+        # valid payload, model raises → 500 (internal), not 400
+        req = urllib.request.Request(
+            url, data=b"[[1.0, 2.0]]", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+            assert "updater state poisoned" in json.loads(e.read())["error"]
+
+        # the client turns the HTTPError into the server's message
+        client = JsonModelClient(port=server.port)
+        try:
+            client.predict([[1.0, 2.0]])
+            raise AssertionError("expected RuntimeError")
+        except RuntimeError as e:
+            assert "500" in str(e) and "updater state poisoned" in str(e)
+
+        # undecodable payload stays a client error (400) end to end
+        try:
+            client.predict(["not", "numbers"])
+            raise AssertionError("expected RuntimeError")
+        except RuntimeError as e:
+            assert "400" in str(e)
+    finally:
+        server.stop()
+
+
 def test_json_model_server_roundtrip():
     net = _net()
     server = JsonModelServer.Builder(net).port(0).build().start()
