@@ -8,9 +8,17 @@ per batch at execute time, matching the async-prefetch design §2.4 C12).
 
 from __future__ import annotations
 
+import sys
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+
+def _is_device_array(x) -> bool:
+    """True for an already-placed jax.Array — checked WITHOUT importing jax
+    (this module must stay importable in jax-free tooling contexts)."""
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(x, jax.Array)
 
 
 def _to_np(x):
@@ -18,9 +26,15 @@ def _to_np(x):
         return None
     if isinstance(x, np.ndarray):
         return x
+    # device-resident arrays (DevicePrefetchIterator staging) pass through
+    # untouched: np.asarray here would be a blocking d2h copy that the
+    # fit loop immediately re-uploads — the exact round trip the device
+    # pipeline exists to remove
+    if _is_device_array(x):
+        return x
     if hasattr(x, "numpy"):
         return x.numpy()
-    return np.asarray(x)
+    return np.asarray(x)  # host-ok: device arrays returned above
 
 
 class DataSet:

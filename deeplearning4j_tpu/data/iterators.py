@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -85,8 +86,8 @@ class ArrayDataSetIterator(DataSetIterator):
     epoch (the common INDArray fit path)."""
 
     def __init__(self, features, labels, batch_size: int, shuffle: bool = False, seed: int = 0, drop_last: bool = False):
-        self.features = np.asarray(features) if not hasattr(features, "numpy") else features.numpy()
-        self.labels = np.asarray(labels) if not hasattr(labels, "numpy") else labels.numpy()
+        self.features = np.asarray(features) if not hasattr(features, "numpy") else features.numpy()  # host-ok: in-memory host dataset by contract
+        self.labels = np.asarray(labels) if not hasattr(labels, "numpy") else labels.numpy()  # host-ok: see above
         self.batch_size = batch_size
         self.shuffle = shuffle
         self._seed = seed
@@ -135,30 +136,68 @@ class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch wrapper (AsyncDataSetIterator parity):
     bounded queue of ready batches; the training loop overlaps host ETL with
     device execution. The reference pins prefetched buffers in workspaces; on
-    TPU the equivalent is simply keeping batches host-staged until dispatch."""
+    TPU the equivalent is simply keeping batches host-staged until dispatch
+    (see :class:`DevicePrefetchIterator` for the device-staged variant).
+
+    An ETL error in the worker is captured and re-raised from the consumer's
+    ``next()``/``has_next()`` once the buffered batches drain — never a
+    silently truncated epoch. ``reset()`` signals a stop event instead of
+    draining the remaining epoch, so early stop costs O(queue_size) batches,
+    not O(epoch).
+    """
 
     _END = object()
+    _PUT_POLL_S = 0.05  # worker re-checks the stop event at this cadence
 
     def __init__(self, base: DataSetIterator, queue_size: int = 4):
         self._base = base
         self._size = queue_size
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
         self._next_item = None
         self._exhausted = False
+
+    def _stage(self, ds: DataSet) -> DataSet:
+        """Hook: transform a batch ON THE WORKER THREAD before it is queued
+        (DevicePrefetchIterator overrides this with device placement)."""
+        return ds
+
+    def _on_queued(self, q) -> None:
+        """Hook: a staged batch actually entered ``q`` (NOT called for a put
+        aborted by reset) — DevicePrefetchIterator updates its depth gauge
+        here."""
 
     def _start(self):
         """Lazy start: the worker spins up on first has_next()/next() so a
         reset() before any consumption doesn't waste a full ETL pass."""
         self._exhausted = False
+        self._error = None
+        self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=self._size)
+        stop, q = self._stop, self._queue  # bind: reset() swaps the fields
+
+        def put_stoppable(item) -> bool:
+            """Bounded put that aborts when reset() signals stop."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=self._PUT_POLL_S)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
-                while self._base.has_next():
-                    self._queue.put(self._base.next())
+                while not stop.is_set() and self._base.has_next():
+                    if not put_stoppable(self._stage(self._base.next())):
+                        return
+                    self._on_queued(q)
+            except Exception as e:  # captured, re-raised consumer-side
+                self._error = e
             finally:
-                self._queue.put(self._END)
+                put_stoppable(self._END)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -176,27 +215,182 @@ class AsyncDataSetIterator(DataSetIterator):
         else:
             self._next_item = item
 
+    def _raise_if_failed(self):
+        # the error sticks (every subsequent call re-raises) so no caller can
+        # mistake the failed tail of the epoch for a clean end — only reset()
+        # clears it
+        if self._exhausted and self._error is not None:
+            raise self._error
+
     def has_next(self) -> bool:
         self._ensure_started()
+        self._raise_if_failed()
         return not self._exhausted
 
     def next(self) -> DataSet:
         self._ensure_started()
+        self._raise_if_failed()
+        if self._exhausted:
+            # the worker is gone — blocking on the queue here would hang
+            # forever; surface the misuse instead
+            raise StopIteration("epoch exhausted; call reset() first")
         item = self._next_item
         self._advance()
         return item
 
     def reset(self) -> None:
         if self._thread is not None:
-            # drain so the worker can exit
-            while not self._exhausted:
-                self._advance()
-            self._thread.join()
+            # signal stop, then drain whatever is buffered so a worker
+            # blocked in put() can observe the event — O(queue_size), not
+            # O(epoch): the rest of the epoch is never produced
+            self._stop.set()
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    if not self._thread.is_alive():
+                        break
+                    time.sleep(self._PUT_POLL_S / 10)
             self._thread = None
+        self._error = None
+        self._next_item = None
+        self._exhausted = False
         self._base.reset()
 
     def batch(self) -> int:
         return self._base.batch()
+
+
+class DevicePrefetchIterator(AsyncDataSetIterator):
+    """Asynchronously ``jax.device_put`` the next ``buffer_size`` batches
+    while the current step executes (the TPU analog of DL4J's
+    AsyncDataSetIterator + pinned workspaces, SURVEY §2.4 C12).
+
+    The worker thread stages each batch to device — optionally directly with
+    a mesh ``sharding``, the one-shot placement of Rink et al.
+    (arXiv:2112.01075) — and blocks until the transfer completes, so a batch
+    popped by the consumer is already resident in HBM and the fit loop's
+    ``_put`` degenerates to a no-op. Device memory is bounded by
+    ``buffer_size + 2`` batches (queue + the consumer's current/next items).
+
+    Telemetry (``monitoring`` registry): ``tdl_h2d_bytes_total`` /
+    ``tdl_h2d_seconds`` (true transfer time, measured worker-side),
+    ``tdl_prefetch_queue_depth``, ``tdl_input_wait_seconds`` (per-step
+    consumer wait — ≈0 when prefetch keeps up) and
+    ``tdl_input_starved_steps_total``. ``wait_seconds`` keeps the raw
+    per-step waits for tests/bench.
+    """
+
+    STARVED_S = 1e-3  # a step that waited longer than this was input-bound
+
+    def __init__(self, base: DataSetIterator, buffer_size: int = 2,
+                 sharding=None, registry=None):
+        super().__init__(base, queue_size=buffer_size)
+        self._sharding = sharding
+        if registry is None:
+            from ..monitoring import get_registry
+
+            registry = get_registry()
+        self._h2d_bytes = registry.counter(
+            "tdl_h2d_bytes_total", "Bytes moved host→device by input staging")
+        self._h2d_seconds = registry.counter(
+            "tdl_h2d_seconds", "Seconds spent in host→device input transfers")
+        self._depth = registry.gauge(
+            "tdl_prefetch_queue_depth", "Device-resident batches ready ahead "
+            "of the consumer")
+        self._wait_hist = registry.histogram(
+            "tdl_input_wait_seconds",
+            "Per-step consumer wait for the next input batch",
+            buckets=(1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+        self._starved = registry.counter(
+            "tdl_input_starved_steps_total",
+            "Steady-state steps that blocked on input longer than 1ms")
+        # recent per-step waits for stats()/tests — bounded (multi-million-
+        # step runs must not accumulate a float per step); the full
+        # distribution lives in the registry histogram
+        self.wait_seconds: List[float] = []
+        self._wait_cap = 4096
+        self._steps = 0  # advances this epoch (reset() zeroes it)
+
+    def _stage(self, ds: DataSet) -> DataSet:
+        """Runs on the worker thread: place every array of the batch on
+        device (with the mesh sharding when set) and wait for the copy, so
+        consumers only ever see fully-resident batches."""
+        import jax
+
+        sharding = self._sharding
+        if sharding is not None and ds.features is not None:
+            # shard_shape is the sharding-type-agnostic divisibility oracle
+            # (it raises on a batch the sharding can't split evenly)
+            try:
+                sharding.shard_shape(tuple(np.shape(ds.features)))
+            except Exception:
+                sharding = None  # remainder batch: default placement; the
+                # trainer's remainder path slices it device-side
+        t0 = time.perf_counter()
+        nbytes = 0
+        placed = []
+        for a in (ds.features, ds.labels, ds.features_mask, ds.labels_mask):
+            if a is None:
+                placed.append(None)
+                continue
+            if not isinstance(a, jax.Array):
+                nbytes += a.nbytes
+            placed.append(jax.device_put(a, sharding) if sharding is not None
+                          else jax.device_put(a))
+        jax.block_until_ready([p for p in placed if p is not None])
+        self._h2d_bytes.inc(nbytes)
+        self._h2d_seconds.inc(time.perf_counter() - t0)
+        return DataSet(*placed)
+
+    def _on_queued(self, q) -> None:
+        # only after the put succeeded — a reset-aborted put must not leave
+        # the gauge counting a batch that never entered the queue
+        self._depth.set(q.qsize())
+
+    _WARMUP_STEPS = 2  # queue fill + compile: waits here are not starvation
+
+    def _advance(self):
+        t0 = time.perf_counter()
+        super()._advance()
+        wait = time.perf_counter() - t0
+        self._steps += 1
+        if len(self.wait_seconds) >= self._wait_cap:
+            del self.wait_seconds[:self._wait_cap // 2]
+        self.wait_seconds.append(wait)
+        self._wait_hist.observe(wait)
+        if wait > self.STARVED_S and self._steps > self._WARMUP_STEPS:
+            self._starved.inc()
+        self._depth.set(self._queue.qsize())
+
+    def reset(self) -> None:
+        super().reset()
+        # per-epoch wait stats: a fresh epoch has its own queue-fill warmup
+        self.wait_seconds = []
+        self._steps = 0
+
+    def stats(self) -> dict:
+        """Pipeline health snapshot (what bench.py's ``pipeline`` block
+        reports): true h2d bytes/seconds/MBps measured worker-side, plus the
+        consumer's per-step input wait. ``input_wait_ms_per_step`` skips the
+        first ``_WARMUP_STEPS`` waits — queue fill + compile, not steady
+        state — so an epoch shorter than the warmup reports 0.0 rather than
+        passing queue-fill latency off as starvation. ``epoch_steps`` counts
+        this epoch's advances (``wait_seconds`` itself is a bounded recent
+        window)."""
+        warm = max(0, self._WARMUP_STEPS - (self._steps - len(self.wait_seconds)))
+        steady = self.wait_seconds[warm:]
+        return {
+            "h2d_bytes": int(self._h2d_bytes.value),
+            "h2d_seconds": round(self._h2d_seconds.value, 4),
+            "h2d_MBps": round(
+                self._h2d_bytes.value / 1e6 / self._h2d_seconds.value, 1)
+            if self._h2d_seconds.value else 0.0,
+            "input_wait_ms_per_step": round(
+                float(np.mean(steady)) * 1e3, 3) if steady else 0.0,
+            "starved_steps": int(self._starved.value),
+            "epoch_steps": self._steps,
+        }
 
 
 class MultiDataSetIterator:

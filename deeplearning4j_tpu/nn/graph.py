@@ -42,6 +42,11 @@ class ComputationGraph(_LazyScoreMixin):
         self._types = conf.infer_types()  # output type per node
         self._in_types = self._compute_in_types()
         self._jit_cache: Dict[str, Any] = {}
+        # on-device input ingest (narrow wire format): set_device_ingest /
+        # _ingest_input / _wire_dtype come from _LazyScoreMixin. A plain
+        # callable applies to EVERY network input; multi-input graphs pass a
+        # dict keyed by input name so e.g. an image scaler never touches a
+        # dense side-input.
 
     def _compute_in_types(self):
         """Input InputType per node AFTER its preprocessor."""
@@ -148,7 +153,8 @@ class ComputationGraph(_LazyScoreMixin):
         def step(params, upd_state, bn_state, iteration, epoch, inputs, labels, lmasks, rng):
             def loss_fn(p):
                 pc = cast_floating(p, cdt) if amp else p
-                xc = {k: cast_input(v, cdt) for k, v in inputs.items()} if amp else inputs
+                xi = {k: self._ingest_input(k, v) for k, v in inputs.items()}
+                xc = {k: cast_input(v, cdt) for k, v in xi.items()} if amp else xi
                 return self._forward(pc, bn_state, xc, training=True, rng=rng, labels=labels, lmasks=lmasks)
 
             (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -268,12 +274,17 @@ class ComputationGraph(_LazyScoreMixin):
         return out
 
     def _coerce_inputs(self, features) -> Dict[str, jnp.ndarray]:
+        # device-resident arrays pass straight through (no host round trip);
+        # for inputs with an on-device ingest installed the wire dtype is
+        # preserved so uint8 batches stay 4x narrower over the h2d link
         if isinstance(features, dict):
-            return {k: jnp.asarray(v, self._dtype) for k, v in features.items()}
+            return {k: jnp.asarray(v, self._wire_dtype(k))
+                    for k, v in features.items()}
         if not isinstance(features, (list, tuple)):
             features = [features]
         return {
-            name: jnp.asarray(f.numpy() if hasattr(f, "numpy") else f, self._dtype)
+            name: jnp.asarray(f.numpy() if hasattr(f, "numpy") else f,
+                              self._wire_dtype(name))
             for name, f in zip(self.conf.network_inputs, features)
         }
 
@@ -344,6 +355,7 @@ class ComputationGraph(_LazyScoreMixin):
     def output(self, *features) -> List[NDArray]:
         if "output" not in self._jit_cache:
             def fwd(params, bn_state, inputs):
+                inputs = {k: self._ingest_input(k, v) for k, v in inputs.items()}
                 outs, _ = self._forward(params, bn_state, inputs, training=False, rng=None)
                 return outs
 
@@ -359,6 +371,7 @@ class ComputationGraph(_LazyScoreMixin):
         if ds is None:
             return self.score_
         inputs = self._coerce_inputs([ds.features] if isinstance(ds, DataSet) else list(ds.features))
+        inputs = {k: self._ingest_input(k, v) for k, v in inputs.items()}
         labels = self._coerce_labels([ds.labels] if isinstance(ds, DataSet) else list(ds.labels))
         loss, _ = self._forward(self.params_, self.bn_state, inputs, training=False, rng=None, labels=labels)
         return float(loss)
@@ -396,7 +409,7 @@ class ComputationGraph(_LazyScoreMixin):
         return sum(int(np.prod(w.shape)) for _, _, w in self._param_entries())
 
     def set_params(self, flat) -> None:
-        arr = np.asarray(flat.numpy() if hasattr(flat, "numpy") else flat).reshape(-1)
+        arr = np.asarray(flat.numpy() if hasattr(flat, "numpy") else flat).reshape(-1)  # host-ok: set_params ingests user input
         expected = self.num_params()
         if arr.size != expected:
             raise ValueError(f"param vector length {arr.size} != model numParams {expected}")

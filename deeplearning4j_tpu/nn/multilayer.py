@@ -99,6 +99,48 @@ class _LazyScoreMixin:
         # device arrays are stored as-is (no sync); floats pass through
         self.__dict__["_score_v"] = v if not isinstance(v, (int, float)) else float(v)
 
+    # -- on-device input ingest (narrow wire format) ------------------------
+    # shared by MultiLayerNetwork and ComputationGraph: the installed fn runs
+    # INSIDE the compiled step on the raw wire batch (uint8 NHWC → f32 NCHW
+    # normalized); see data.normalizers.make_device_ingest
+
+    _device_ingest = None
+
+    def set_device_ingest(self, fn):
+        """Install ``fn`` (raw wire batch → f32 model-layout batch, pure jnp)
+        to run inside the compiled train/inference step. Pass None to remove.
+        On ComputationGraph, a dict ``{input_name: fn}`` scopes ingests to
+        specific inputs (others stage at model dtype, untouched); a dict is
+        rejected here on single-input networks. Clears the jit cache — the
+        ingest is traced into the executables."""
+        if isinstance(fn, dict) and not hasattr(self.conf, "network_inputs"):
+            raise TypeError(
+                "a dict of ingests needs named inputs (ComputationGraph); "
+                "MultiLayerNetwork takes a single callable")
+        self._device_ingest = fn
+        self._jit_cache.clear()
+        return self
+
+    def _ingest_fn(self, name=None):
+        fn = self._device_ingest
+        return fn.get(name) if isinstance(fn, dict) else fn
+
+    def _ingest_input(self, name, x):
+        f = self._ingest_fn(name)
+        return x if f is None else jnp.asarray(f(x), self._dtype)
+
+    def _wire_dtype(self, name=None):
+        """Staging dtype for one input: None (keep the narrow wire dtype,
+        e.g. uint8) when an on-device ingest will cast inside the step."""
+        return None if self._ingest_fn(name) is not None else self._dtype
+
+    # single-input forms (MultiLayerNetwork)
+    def _ingest(self, x):
+        return self._ingest_input(None, x)
+
+    def _features_dtype(self):
+        return self._wire_dtype()
+
 
 class MultiLayerNetwork(_LazyScoreMixin):
     def __init__(self, conf: MultiLayerConfiguration):
@@ -122,7 +164,12 @@ class MultiLayerNetwork(_LazyScoreMixin):
     def _put(self, arr, dtype=None):
         if arr is None:
             return None
-        a = jnp.asarray(arr, dtype) if dtype is not None else jnp.asarray(arr)
+        if isinstance(arr, jax.Array):
+            # already staged (DevicePrefetchIterator): no host copy, no
+            # re-upload — at most an on-device cast / sharding no-op
+            a = arr if dtype is None or arr.dtype == dtype else arr.astype(dtype)
+        else:
+            a = jnp.asarray(arr, dtype) if dtype is not None else jnp.asarray(arr)
         return self._input_put(a) if self._input_put is not None else a
 
     # ------------------------------------------------------------------ init
@@ -233,7 +280,8 @@ class MultiLayerNetwork(_LazyScoreMixin):
         def step(params, upd_state, bn_state, iteration, epoch, x, y, fmask, lmask, rng):
             def lossf(p):
                 pc = cast_floating(p, cdt) if amp else p
-                xc = cast_input(x, cdt) if amp else x
+                xi = self._ingest(x)  # on-device: cast/layout/normalize
+                xc = cast_input(xi, cdt) if amp else xi
                 return self._loss_fn(pc, bn_state, xc, y, fmask, lmask, rng, True)
 
             (loss, (new_bn, _)), grads = jax.value_and_grad(lossf, has_aux=True)(params)
@@ -274,7 +322,8 @@ class MultiLayerNetwork(_LazyScoreMixin):
         def step(params, upd_state, bn_state, rnn_states, iteration, epoch, x, y, fmask, lmask, rng):
             def loss_with_states(p):
                 pc = cast_floating(p, cdt) if amp else p
-                xc = cast_input(x, cdt) if amp else x
+                xi = self._ingest(x)
+                xc = cast_input(xi, cdt) if amp else xi
                 return self._loss_fn(pc, bn_state, xc, y, fmask, lmask, rng, True, rnn_states)
 
             (loss, (new_bn, new_rnn)), grads = jax.value_and_grad(loss_with_states, has_aux=True)(params)
@@ -343,8 +392,8 @@ class MultiLayerNetwork(_LazyScoreMixin):
         elif isinstance(data, DataSet):
             it = ListDataSetIterator([data])
         else:
-            f = data.numpy() if hasattr(data, "numpy") else np.asarray(data)
-            l = labels.numpy() if hasattr(labels, "numpy") else np.asarray(labels)
+            f = data.numpy() if hasattr(data, "numpy") else np.asarray(data)  # host-ok: fit(features, labels) batches/shuffles host-side
+            l = labels.numpy() if hasattr(labels, "numpy") else np.asarray(labels)  # host-ok: see above
             it = ArrayDataSetIterator(f, l, batch_size or f.shape[0])
         for _ in range(epochs):
             for ds in it:
@@ -404,7 +453,7 @@ class MultiLayerNetwork(_LazyScoreMixin):
                     (ds.labels_mask is not None) != has_lm:
                 raise ValueError("fit_scan: all datasets must agree on "
                                  "features/labels masks")
-        xs = jnp.stack([self._put(ds.features, self._dtype) for ds in datasets])
+        xs = jnp.stack([self._put(ds.features, self._features_dtype()) for ds in datasets])
         ys = jnp.stack([self._put(ds.labels) for ds in datasets])
         fms = (jnp.stack([self._put(ds.features_mask) for ds in datasets])
                if has_fm else None)
@@ -437,7 +486,7 @@ class MultiLayerNetwork(_LazyScoreMixin):
             return
         step = self._train_step_fn()
         rng = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self.iteration)
-        x = self._put(ds.features, self._dtype)
+        x = self._put(ds.features, self._features_dtype())
         y = self._put(ds.labels)
         fmask = self._put(ds.features_mask)
         lmask = self._put(ds.labels_mask)
@@ -473,27 +522,45 @@ class MultiLayerNetwork(_LazyScoreMixin):
         segments are device-side slices — per-segment round trips were the
         r3 LSTM bench bottleneck."""
         fwd = self.conf.tbptt_fwd_length
-        x_all = np.asarray(ds.features)
-        y_all = np.asarray(ds.labels)
+
+        def stage(a, dtype=None):
+            """Keep numpy host-side (padding/segmentation before ONE bulk
+            transfer) and device arrays device-side (a DevicePrefetchIterator
+            batch must not round-trip d2h→h2d — pad/segment run as jnp ops)."""
+            if isinstance(a, jax.Array):
+                return a if dtype is None or a.dtype == dtype else a.astype(dtype)
+            return np.asarray(a, dtype) if dtype is not None else np.asarray(a)  # host-ok: numpy path; device arrays handled above
+
+        def xp(a):
+            return jnp if isinstance(a, jax.Array) else np
+
+        def pad_tail(a, pad):
+            return xp(a).pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
+
+        x_all = stage(ds.features)
+        y_all = stage(ds.labels)
         T = x_all.shape[-1]
         B = x_all.shape[0]
         rnn_states = self._zero_rnn_states(B)
-        lm_all = (np.asarray(ds.labels_mask, np.float32) if ds.labels_mask is not None
+        lm_all = (stage(ds.labels_mask, np.float32) if ds.labels_mask is not None
                   else np.ones((B, T), np.float32))
-        fm_all = None if ds.features_mask is None else np.asarray(ds.features_mask, np.float32)
+        fm_all = None if ds.features_mask is None else stage(ds.features_mask, np.float32)
         pad = (-T) % fwd
         if pad:
             # pad the tail ONCE to a fwd multiple so ONE executable serves all
             # segments (static shapes — §7.2 hard part #3); padded steps are
             # masked out ON TOP of any user mask
-            x_all = np.pad(x_all, [(0, 0)] * (x_all.ndim - 1) + [(0, pad)])
-            y_all = np.pad(y_all, [(0, 0)] * (y_all.ndim - 1) + [(0, pad)])
-            lm_all = np.pad(lm_all, [(0, 0)] * (lm_all.ndim - 1) + [(0, pad)])
+            x_all = pad_tail(x_all, pad)
+            y_all = pad_tail(y_all, pad)
+            lm_all = pad_tail(lm_all, pad)
             if fm_all is not None:
-                fm_all = np.pad(fm_all, [(0, 0)] * (fm_all.ndim - 1) + [(0, pad)])
+                fm_all = pad_tail(fm_all, pad)
         S = x_all.shape[-1] // fwd
-        seg_weights = np.asarray(
-            [np.sum(lm_all[..., s * fwd:(s + 1) * fwd]) for s in range(S)], np.float32)
+        # per-segment unmasked-timestep weights; stays device-side (lazy) for
+        # a device-resident mask, numpy for the host path
+        seg_weights = xp(lm_all).moveaxis(
+            lm_all.reshape(*lm_all.shape[:-1], S, fwd), -2, 0
+        ).reshape(S, -1).sum(axis=1).astype(np.float32)
 
         def to_segs(a):
             """[..., S*fwd] → [S, ..., fwd] device-side."""
@@ -524,11 +591,19 @@ class MultiLayerNetwork(_LazyScoreMixin):
         # fit-wide score = unmasked-timestep-weighted mean over segments (the
         # reference reports one score per fit call, not per tbptt segment);
         # computed device-side, synced lazily on first score_ read
-        weight_total = float(seg_weights.sum())
-        if weight_total > 0:
-            self.score_ = (losses * jnp.asarray(seg_weights)).sum() / weight_total
+        if isinstance(seg_weights, jax.Array):
+            # device-resident mask: keep the whole score computation lazy
+            # (an eager float() here would sync every prefetched fit)
+            wt = seg_weights.sum()
+            self.score_ = jnp.where(
+                wt > 0, (losses * seg_weights).sum() / jnp.maximum(wt, 1e-12),
+                losses[-1])
         else:
-            self.score_ = losses[-1]
+            weight_total = float(seg_weights.sum())
+            if weight_total > 0:
+                self.score_ = (losses * jnp.asarray(seg_weights)).sum() / weight_total
+            else:
+                self.score_ = losses[-1]
         self.iteration += 1
         for lst in self.listeners:
             if hasattr(lst, "iteration_done"):
@@ -562,6 +637,7 @@ class MultiLayerNetwork(_LazyScoreMixin):
         source of truth for output() and the compiled artifact export."""
 
         def fwd(params, bn_state, x):
+            x = self._ingest(x)
             h, _, _ = self._forward(params, bn_state, x, training=False, rng=None)
             return self._head_forward(params, h)
 
@@ -571,12 +647,14 @@ class MultiLayerNetwork(_LazyScoreMixin):
         """Forward to final layer activations (MultiLayerNetwork.output)."""
         if "output" not in self._jit_cache:
             self._jit_cache["output"] = jax.jit(self._inference_fn())
-        xj = jnp.asarray(x.numpy() if hasattr(x, "numpy") else x, self._dtype)
+        xj = jnp.asarray(x.numpy() if hasattr(x, "numpy") else x,
+                         self._features_dtype())
         return NDArray(self._jit_cache["output"](self.params_, self.bn_state, xj))
 
     def feed_forward(self, x) -> List[NDArray]:
         """All layer activations (MultiLayerNetwork.feedForward)."""
-        xj = jnp.asarray(x.numpy() if hasattr(x, "numpy") else x, self._dtype)
+        xj = self._ingest(jnp.asarray(x.numpy() if hasattr(x, "numpy") else x,
+                                      self._features_dtype()))
         acts, _, _ = self._forward(self.params_, self.bn_state, xj, training=False, rng=None, collect=True)
         out = self._head_forward(self.params_, acts[-1] if acts else xj)
         return [NDArray(a) for a in acts] + [NDArray(out)]
@@ -585,7 +663,7 @@ class MultiLayerNetwork(_LazyScoreMixin):
         """Score = loss on dataset (Model.score)."""
         if ds is None:
             return self.score_
-        x = jnp.asarray(ds.features, self._dtype)
+        x = self._ingest(jnp.asarray(ds.features, self._features_dtype()))
         y = jnp.asarray(ds.labels)
         fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
@@ -653,14 +731,14 @@ class MultiLayerNetwork(_LazyScoreMixin):
     def params(self) -> NDArray:
         """Flat 1-D view of all parameters (deterministic order), parity with
         MultiLayerNetwork.params() flat buffer."""
-        chunks = [np.asarray(w).reshape(-1) for _, _, w in self._param_entries()]
+        chunks = [np.asarray(w).reshape(-1) for _, _, w in self._param_entries()]  # host-ok: params() export is an intentional d2h
         return NDArray(jnp.concatenate([jnp.asarray(c) for c in chunks]) if chunks else jnp.zeros((0,)))
 
     def num_params(self) -> int:
         return sum(int(np.prod(w.shape)) for _, _, w in self._param_entries())
 
     def set_params(self, flat) -> None:
-        arr = np.asarray(flat.numpy() if hasattr(flat, "numpy") else flat).reshape(-1)
+        arr = np.asarray(flat.numpy() if hasattr(flat, "numpy") else flat).reshape(-1)  # host-ok: set_params ingests user input
         expected = self.num_params()
         if arr.size != expected:
             raise ValueError(f"param vector length {arr.size} != model numParams {expected}")

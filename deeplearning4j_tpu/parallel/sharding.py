@@ -112,6 +112,16 @@ def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
     return placed
 
 
+def batch_sharding(mesh: Mesh, data_axis: str = AXIS_DATA) -> NamedSharding:
+    """The canonical minibatch placement: leading (batch) dim split over the
+    mesh data axis, everything else replicated. One ``jax.device_put(batch,
+    batch_sharding(mesh))`` distributes a host batch to the whole gang in a
+    single one-shot redistribution (Rink et al., arXiv:2112.01075) — this is
+    what ``ParallelTrainer.batch_sharding`` and ``DevicePrefetchIterator``
+    thread through the data-parallel input pipeline."""
+    return NamedSharding(mesh, P(data_axis))
+
+
 def shard_batch(batch, mesh: Mesh, data_axis: str = AXIS_DATA):
     """Shard leading (batch) dim of every leaf over the data axis."""
 

@@ -119,10 +119,36 @@ class ParallelTrainer:
                 out[k] = self._replicate(sub)
         return out
 
+    # -- input staging ------------------------------------------------------
+
+    def batch_sharding(self):
+        """The NamedSharding a minibatch should be placed with: leading
+        (batch) dim split over the mesh data axis. Hand this to
+        :class:`~deeplearning4j_tpu.data.iterators.DevicePrefetchIterator`
+        so batches land pre-sharded in ONE ``device_put`` — the portable
+        one-shot redistribution of Rink et al. (arXiv:2112.01075) — and the
+        fit loop's placement hook becomes a no-op."""
+        from .sharding import batch_sharding
+
+        return batch_sharding(self.mesh, self.data_axis)
+
+    def prefetch(self, iterator, buffer_size: int = 2):
+        """Wrap ``iterator`` so the next ``buffer_size`` batches stage to
+        the mesh (sharded, asynchronously) while the current step runs."""
+        from ..data.iterators import DevicePrefetchIterator
+
+        return DevicePrefetchIterator(iterator, buffer_size=buffer_size,
+                                      sharding=self.batch_sharding())
+
     # -- fit ----------------------------------------------------------------
 
-    def fit(self, iterator, epochs: int = 1):
+    def fit(self, iterator, epochs: int = 1, prefetch: int = 0):
+        """``prefetch=K`` overlaps host ETL + h2d staging of the next K
+        batches with device execution (0 = synchronous staging, the
+        pre-device-pipeline behavior)."""
         self._place_net()
+        if prefetch:
+            iterator = self.prefetch(iterator, buffer_size=prefetch)
         for _ in range(epochs):
             for ds in iterator:
                 self._fit_batch(ds)
@@ -130,7 +156,7 @@ class ParallelTrainer:
         return self.net
 
     def _fit_batch(self, ds: DataSet):
-        b = np.asarray(ds.features).shape[0]
+        b = ds.num_examples()  # shape read only: never syncs a device batch
         rem = b % self._ndata
         if rem:
             # trim to divisibility; remainder goes through a replicated step
@@ -224,13 +250,23 @@ class MultiProcessTrainer(ParallelTrainer):
                 "make_array_from_process_local_data per-shard construction")
         super().__init__(net, mesh, data_axis)
 
+    def prefetch(self, iterator, buffer_size: int = 2):
+        """Host-staged prefetch only: one-shot sharded ``jax.device_put``
+        cannot address a multi-process mesh (the global batch is assembled
+        per-process via ``make_array_from_process_local_data`` in ``_shard``,
+        which needs host buffers). Overlapping ETL with the step still pays;
+        the h2d copy itself stays on the consumer thread."""
+        from ..data.iterators import AsyncDataSetIterator
+
+        return AsyncDataSetIterator(iterator, queue_size=buffer_size)
+
     def _fit_batch(self, ds: DataSet):
         # the single-process remainder fallback cannot cross process
         # boundaries (it would mix global params with per-process inputs), so
         # multiprocess input pipelines must feed divisible LOCAL batches
         import jax
 
-        b = np.asarray(ds.features).shape[0]
+        b = ds.num_examples()
         local = max(1, len(self.mesh.devices.flat) // jax.process_count())
         if b % local:
             raise ValueError(
@@ -245,14 +281,14 @@ class MultiProcessTrainer(ParallelTrainer):
         def put(x):
             if not hasattr(x, "dtype"):
                 return x
-            return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+            return jax.make_array_from_process_local_data(sharding, np.asarray(x))  # host-ok: API requires host buffers
 
         return jax.tree.map(put, tree)
 
     def _shard(self, x):
         if x is None:
             return None
-        x = np.asarray(x)
+        x = np.asarray(x)  # host-ok: make_array_from_process_local_data requires host buffers
         spec = P(self.data_axis, *([None] * (x.ndim - 1)))
         return jax.make_array_from_process_local_data(NamedSharding(self.mesh, spec), x)
 
@@ -262,7 +298,9 @@ class MultiProcessTrainer(ParallelTrainer):
 
 def _slice_ds(ds: DataSet, a: int, b: int) -> DataSet:
     def s(x):
-        return None if x is None else np.asarray(x)[a:b]
+        # plain slicing works for numpy AND device arrays — np.asarray here
+        # would pull a device-resident batch back to host (d2h→h2d round trip)
+        return None if x is None else x[a:b]
 
     return DataSet(s(ds.features), s(ds.labels), s(ds.features_mask), s(ds.labels_mask))
 

@@ -1,0 +1,476 @@
+"""Device-resident input pipeline (ISSUE 4): narrow uint8 wire format +
+on-device normalization parity, DevicePrefetchIterator overlap/placement,
+async-iterator error propagation and cheap reset, sharded gang prefetch."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+    DataSet,
+    DevicePrefetchIterator,
+    ImagePreProcessingScaler,
+    ListDataSetIterator,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    make_device_ingest,
+)
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.monitoring import MetricsRegistry
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    InputType,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.parallel import ParallelTrainer, build_mesh
+
+
+# ----------------------------------------------------------------- fake bases
+
+
+class CountingIterator(DataSetIterator):
+    """n batches of (batch, 4) floats; counts next() calls across resets."""
+
+    def __init__(self, n=500, batch=8, delay_s=0.0, fail_at=None):
+        self.n, self._batch, self.delay_s = n, batch, delay_s
+        self.fail_at = fail_at
+        self.next_calls = 0
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < self.n
+
+    def next(self):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail_at is not None and self._pos == self.fail_at:
+            raise RuntimeError(f"ETL blew up at batch {self._pos}")
+        self.next_calls += 1
+        x = np.full((self._batch, 4), self._pos, np.float32)
+        y = np.eye(2, dtype=np.float32)[np.arange(self._batch) % 2]
+        self._pos += 1
+        return DataSet(x, y)
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self._batch
+
+
+# --------------------------------------------- satellite: error propagation
+
+
+class TestAsyncErrorPropagation:
+    def test_etl_error_reraised_not_truncated(self):
+        it = AsyncDataSetIterator(CountingIterator(n=10, fail_at=3), queue_size=2)
+        seen = 0
+        with pytest.raises(RuntimeError, match="blew up at batch 3"):
+            while it.has_next():
+                it.next()
+                seen += 1
+        # every batch produced before the failure is delivered, then the
+        # error surfaces — the epoch is not silently cut short
+        assert seen == 3
+
+    def test_error_sticks_until_reset(self):
+        it = AsyncDataSetIterator(CountingIterator(n=10, fail_at=0), queue_size=2)
+        with pytest.raises(RuntimeError):
+            it.has_next()
+        with pytest.raises(RuntimeError):  # sticky: can't mistake for clean end
+            it.next()
+        base = CountingIterator(n=4)
+        it._base = base  # swap in a healthy base; reset must clear the error
+        it.reset()
+        assert sum(1 for _ in it) == 4
+
+    def test_device_prefetch_propagates_base_exception(self):
+        it = DevicePrefetchIterator(CountingIterator(n=10, fail_at=2),
+                                    buffer_size=2, registry=MetricsRegistry())
+        with pytest.raises(RuntimeError, match="blew up"):
+            while it.has_next():
+                it.next()
+
+
+# --------------------------------------------------- satellite: cheap reset
+
+
+class TestAsyncReset:
+    def test_reset_does_not_drain_epoch(self):
+        base = CountingIterator(n=500)
+        it = AsyncDataSetIterator(base, queue_size=2)
+        for _ in range(3):
+            it.next()
+        it.reset()
+        # worker produced at most consumed + queue + in-flight, not the epoch
+        assert base.next_calls <= 3 + 2 + 2, base.next_calls
+
+    def test_reset_then_full_epoch(self):
+        base = CountingIterator(n=20)
+        it = AsyncDataSetIterator(base, queue_size=3)
+        it.next()
+        it.reset()
+        assert sum(1 for _ in it) == 20
+
+    def test_reset_before_consumption_costs_nothing(self):
+        base = CountingIterator(n=500)
+        it = AsyncDataSetIterator(base, queue_size=2)
+        it.reset()
+        assert base.next_calls == 0
+
+    def test_next_after_exhaustion_raises_not_hangs(self):
+        it = AsyncDataSetIterator(CountingIterator(n=2), queue_size=2)
+        while it.has_next():
+            it.next()
+        with pytest.raises(StopIteration, match="reset"):
+            it.next()
+
+
+# ------------------------------------------------- device prefetch iterator
+
+
+class TestDevicePrefetch:
+    def test_batches_arrive_device_resident(self):
+        reg = MetricsRegistry()
+        it = DevicePrefetchIterator(CountingIterator(n=4), buffer_size=2,
+                                    registry=reg)
+        batches = list(it)
+        assert len(batches) == 4
+        for ds in batches:
+            assert isinstance(ds.features, jax.Array)
+            assert isinstance(ds.labels, jax.Array)
+        stats = it.stats()
+        # 4 batches × (8×4 f32 features + 8×2 f32 labels)
+        assert stats["h2d_bytes"] == 4 * (8 * 4 * 4 + 8 * 2 * 4)
+        assert stats["epoch_steps"] == 5  # 4 batches + the END sentinel pop
+        assert reg.get("tdl_h2d_bytes_total").value == stats["h2d_bytes"]
+
+    def test_fit_with_device_resident_batches_matches_host_path(self):
+        """The fit loop detects already-placed batches (_put passthrough):
+        training through DevicePrefetchIterator is numerically identical to
+        the synchronous host path."""
+        x = np.random.default_rng(0).normal(size=(32, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.arange(32) % 3]
+        dss = [DataSet(x[i:i + 8], y[i:i + 8]) for i in range(0, 32, 8)]
+
+        def _net():
+            conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1))
+                    .list()
+                    .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+                    .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                       loss="mcxent"))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        a, b = _net(), _net()
+        a.fit(ListDataSetIterator(dss))
+        b.fit(DevicePrefetchIterator(ListDataSetIterator(dss), buffer_size=2,
+                                     registry=MetricsRegistry()))
+        np.testing.assert_allclose(a.params().numpy(), b.params().numpy(),
+                                   atol=1e-6)
+
+    def test_overlap_hides_slow_etl(self):
+        """Slow fake iterator (20 ms/batch) + a consumer 'step' slower than
+        ETL → per-step input wait ≈ 0 after warmup: the prefetcher keeps the
+        queue ahead of the consumer."""
+        it = DevicePrefetchIterator(
+            CountingIterator(n=10, delay_s=0.02), buffer_size=3,
+            registry=MetricsRegistry())
+        while it.has_next():
+            it.next()
+            time.sleep(0.04)  # simulated device step, slower than ETL
+        steady = it.wait_seconds[2:]
+        assert steady and float(np.median(steady)) < 0.01, it.wait_seconds
+        assert it.stats()["input_wait_ms_per_step"] < 10.0
+
+    def test_sharded_placement_on_mesh(self):
+        mesh = build_mesh(data=8)
+        from deeplearning4j_tpu.parallel.sharding import batch_sharding
+
+        sh = batch_sharding(mesh)
+        it = DevicePrefetchIterator(CountingIterator(n=3, batch=16),
+                                    buffer_size=2, sharding=sh,
+                                    registry=MetricsRegistry())
+        ds = it.next()
+        assert ds.features.sharding.is_equivalent_to(sh, ds.features.ndim)
+
+    def test_remainder_batch_falls_back_to_default_placement(self):
+        mesh = build_mesh(data=8)
+        from deeplearning4j_tpu.parallel.sharding import batch_sharding
+
+        it = DevicePrefetchIterator(CountingIterator(n=2, batch=12),
+                                    buffer_size=2,
+                                    sharding=batch_sharding(mesh),
+                                    registry=MetricsRegistry())
+        ds = it.next()  # 12 % 8 != 0 → staged unsharded, trainer slices it
+        assert isinstance(ds.features, jax.Array)
+
+
+# ------------------------------------------------------ gang (mesh) prefetch
+
+
+def test_parallel_trainer_prefetch_matches_synchronous():
+    x = np.random.default_rng(1).normal(size=(64, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x[:, :3], axis=1)]
+    dss = [DataSet(x[i:i + 16], y[i:i + 16]) for i in range(0, 64, 16)]
+
+    def _net():
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.05))
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    a, b = _net(), _net()
+    ParallelTrainer(a, mesh=build_mesh(data=8)).fit(ListDataSetIterator(dss))
+    ParallelTrainer(b, mesh=build_mesh(data=8)).fit(ListDataSetIterator(dss),
+                                                    prefetch=2)
+    np.testing.assert_allclose(a.params().numpy(), b.params().numpy(),
+                               atol=1e-6)
+
+
+# ------------------------------------------- narrow wire format: parity tests
+
+
+class TestWireFormatParity:
+    def test_standardize_device_transform_matches_host(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 5).astype(np.float32) * 3 + 1
+        norm = NormalizerStandardize()
+        norm.fit(ListDataSetIterator([DataSet(x, np.zeros((64, 1), np.float32))]))
+        ds = DataSet(x.copy(), None)
+        norm.transform(ds)
+        dev = np.asarray(norm.device_transform(jnp.asarray(x)))
+        np.testing.assert_allclose(dev, ds.features, atol=1e-6)
+
+    def test_standardize_device_transform_matches_host_4d(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(8, 3, 6, 6).astype(np.float32) * 2 - 1
+        norm = NormalizerStandardize()
+        norm.fit(ListDataSetIterator([DataSet(x, np.zeros((8, 1), np.float32))]))
+        ds = DataSet(x.copy(), None)
+        norm.transform(ds)
+        dev = np.asarray(norm.device_transform(jnp.asarray(x)))
+        np.testing.assert_allclose(dev, ds.features, atol=1e-6)
+
+    def test_minmax_device_transform_matches_host(self):
+        rs = np.random.RandomState(2)
+        x = rs.rand(32, 4).astype(np.float32) * 10
+        norm = NormalizerMinMaxScaler()
+        norm.fit(ListDataSetIterator([DataSet(x, np.zeros((32, 1), np.float32))]))
+        ds = DataSet(x.copy(), None)
+        norm.transform(ds)
+        dev = np.asarray(norm.device_transform(jnp.asarray(x)))
+        np.testing.assert_allclose(dev, ds.features, atol=1e-6)
+
+    def test_scaler_device_transform_matches_host(self):
+        rs = np.random.RandomState(3)
+        x = rs.randint(0, 256, (16, 3, 5, 5)).astype(np.float32)
+        scaler = ImagePreProcessingScaler()
+        ds = DataSet(x.copy(), None)
+        scaler.transform(ds)
+        dev = np.asarray(scaler.device_transform(jnp.asarray(x, jnp.uint8)))
+        np.testing.assert_allclose(dev, ds.features, atol=1e-6)
+
+    def test_make_device_ingest_nhwc_uint8(self):
+        rs = np.random.RandomState(4)
+        u8 = rs.randint(0, 256, (6, 8, 8, 3), np.uint8)
+        ingest = make_device_ingest(ImagePreProcessingScaler(),
+                                    source_layout="NHWC")
+        got = np.asarray(ingest(jnp.asarray(u8)))
+        want = u8.transpose(0, 3, 1, 2).astype(np.float32) / 255.0
+        assert got.dtype == np.float32
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_make_device_ingest_rejects_bad_layout(self):
+        with pytest.raises(ValueError, match="NCHW or NHWC"):
+            make_device_ingest(source_layout="HWCN")
+
+    def test_network_output_parity_uint8_wire_vs_host_normalize(self):
+        """End-to-end acceptance parity: uint8 NHWC wire + on-device ingest
+        ≡ float32 NCHW host-normalized input, within 1e-6."""
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Sgd(0.01))
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(6)
+        u8 = rs.randint(0, 256, (5, 8, 8, 3), np.uint8)
+        host_f32 = u8.transpose(0, 3, 1, 2).astype(np.float32) / 255.0
+
+        out_host = net.output(host_f32).numpy()
+        net.set_device_ingest(make_device_ingest(ImagePreProcessingScaler(),
+                                                 source_layout="NHWC"))
+        out_wire = net.output(u8).numpy()
+        np.testing.assert_allclose(out_wire, out_host, atol=1e-6)
+
+        net.set_device_ingest(None)  # removable: host path restored
+        np.testing.assert_allclose(net.output(host_f32).numpy(), out_host,
+                                   atol=1e-6)
+
+    def test_train_step_parity_uint8_wire_vs_host_normalize(self):
+        """One fit step through the compiled-in ingest matches the host-
+        normalized f32 path (the normalization really is inside the step)."""
+        def _net():
+            conf = (NeuralNetConfiguration.Builder().seed(9).updater(Sgd(0.1))
+                    .list()
+                    .layer(ConvolutionLayer(n_out=2, kernel_size=(3, 3),
+                                            activation="relu"))
+                    .layer(OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"))
+                    .set_input_type(InputType.convolutional(6, 6, 1))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        rs = np.random.RandomState(7)
+        u8 = rs.randint(0, 256, (8, 6, 6, 1), np.uint8)
+        y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+        host_f32 = u8.transpose(0, 3, 1, 2).astype(np.float32) / 255.0
+
+        a, b = _net(), _net()
+        a.fit(DataSet(host_f32, y))
+        b.set_device_ingest(make_device_ingest(ImagePreProcessingScaler(),
+                                               source_layout="NHWC"))
+        b.fit(DataSet(u8, y))
+        np.testing.assert_allclose(a.params().numpy(), b.params().numpy(),
+                                   atol=1e-6)
+
+    def test_uint8_wire_is_4x_narrower(self):
+        """The staged bytes really shrink 4x: uint8 wire vs float32 wire for
+        the same images (labels excluded from the comparison)."""
+        rs = np.random.RandomState(8)
+        u8 = rs.randint(0, 256, (16, 8, 8, 3), np.uint8)
+        f32 = u8.astype(np.float32)
+
+        def staged_bytes(feat):
+            reg = MetricsRegistry()
+            it = DevicePrefetchIterator(
+                ListDataSetIterator([DataSet(feat, None)]), buffer_size=1,
+                registry=reg)
+            list(it)
+            return reg.get("tdl_h2d_bytes_total").value
+
+        assert staged_bytes(f32) == 4 * staged_bytes(u8)
+
+
+# ----------------------------------- per-input ingest on ComputationGraph
+
+
+class TestGraphPerInputIngest:
+    """set_device_ingest({input_name: fn}) scopes the ingest to one named
+    input of a multi-input graph — the image input rides the uint8 wire
+    while the dense side input stages at model dtype, untouched."""
+
+    @staticmethod
+    def _build():
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.graph_conf import MergeVertex
+
+        g = (NeuralNetConfiguration.Builder().seed(13).updater(Sgd(0.05))
+             .graph_builder().add_inputs("img", "side")
+             .set_input_types(InputType.convolutional(6, 6, 1),
+                              InputType.feed_forward(4)))
+        g.add_layer("c", ConvolutionLayer(n_out=2, kernel_size=(3, 3),
+                                          activation="relu"), "img")
+        g.add_layer("dimg", DenseLayer(n_out=4, activation="tanh"), "c")
+        g.add_vertex("m", MergeVertex(), "dimg", "side")
+        g.add_layer("output", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "m")
+        g.set_outputs("output")
+        return ComputationGraph(g.build()).init()
+
+    def test_output_parity_dict_ingest(self):
+        rs = np.random.RandomState(11)
+        u8 = rs.randint(0, 256, (5, 6, 6, 1), np.uint8)
+        side = rs.rand(5, 4).astype(np.float32)
+        host_img = u8.transpose(0, 3, 1, 2).astype(np.float32) / 255.0
+
+        net = self._build()
+        out_host = net.output(host_img, side)[0].numpy()
+        net.set_device_ingest({"img": make_device_ingest(
+            ImagePreProcessingScaler(), source_layout="NHWC")})
+        out_wire = net.output(u8, side)[0].numpy()
+        np.testing.assert_allclose(out_wire, out_host, atol=1e-6)
+
+    def test_dict_ingest_rejected_on_multilayer(self):
+        """A dict of ingests needs named inputs — MultiLayerNetwork rejects
+        it at set time instead of failing opaquely mid-jit-trace."""
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=2, activation="tanh"))
+                .layer(OutputLayer(n_in=2, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(TypeError, match="ComputationGraph"):
+            net.set_device_ingest({"input": lambda x: x})
+
+    def test_fit_parity_dict_ingest(self):
+        rs = np.random.RandomState(12)
+        u8 = rs.randint(0, 256, (5, 6, 6, 1), np.uint8)
+        side = rs.rand(5, 4).astype(np.float32)
+        host_img = u8.transpose(0, 3, 1, 2).astype(np.float32) / 255.0
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 5)]
+
+        a, b = self._build(), self._build()
+        a.fit([host_img, side], y)
+        b.set_device_ingest({"img": make_device_ingest(
+            ImagePreProcessingScaler(), source_layout="NHWC")})
+        b.fit([u8, side], y)
+        for name in a.params_:
+            for p in a.params_[name]:
+                np.testing.assert_allclose(
+                    np.asarray(a.params_[name][p]),
+                    np.asarray(b.params_[name][p]), atol=1e-6,
+                    err_msg=f"{name}/{p}")
+
+
+# --------------------------------------- tbptt with device-resident batches
+
+
+def test_tbptt_device_resident_batch_matches_host():
+    """_fit_tbptt pads/segments device arrays with jnp ops (a prefetched
+    batch must not round-trip d2h→h2d) and matches the numpy host path —
+    including the tail-pad branch (T=10, fwd=4) and a device-side mask."""
+    from deeplearning4j_tpu.nn.conf import GravesLSTM, RnnOutputLayer
+
+    B, C, T = 4, 2, 10
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(B, C, T)).astype(np.float32)
+    y = np.moveaxis(np.eye(C, dtype=np.float32)[x.argmax(1)], 2, 1)
+    lmask = np.ones((B, T), np.float32)
+    lmask[:, -3:] = 0.0
+
+    def _rnn():
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.05))
+                .list()
+                .layer(GravesLSTM(n_in=2, n_out=8))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(2))
+                .t_bptt_length(4)
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    a, b = _rnn(), _rnn()
+    a.fit(DataSet(x, y, labels_mask=lmask))
+    b.fit(DataSet(jnp.asarray(x), jnp.asarray(y),
+                  labels_mask=jnp.asarray(lmask)))
+    np.testing.assert_allclose(a.params().numpy(), b.params().numpy(),
+                               atol=1e-6)
+    np.testing.assert_allclose(float(a.score()), float(b.score()), atol=1e-6)

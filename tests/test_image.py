@@ -81,6 +81,46 @@ class TestImageRecordReader:
             batches.append(it.next())
         assert sum(b.features.shape[0] for b in batches) == 12
 
+    def test_uint8_wire_reader_matches_f32_reader(self, image_dir):
+        """Narrow wire format (ISSUE 4): uint8_wire emits HWC uint8 rows;
+        cast+transpose host-side reproduces the default f32 CHW rows exactly."""
+        rr8 = ImageRecordReader(8, 8, 3, ParentPathLabelGenerator(),
+                                uint8_wire=True)
+        rr8.initialize(FileSplit(str(image_dir)))
+        rrf = ImageRecordReader(8, 8, 3, ParentPathLabelGenerator())
+        rrf.initialize(FileSplit(str(image_dir)))
+        while rr8.has_next():
+            u8, lab8 = rr8.next()
+            f32, labf = rrf.next()
+            assert u8.dtype == np.uint8 and u8.shape == (8, 8, 3)
+            assert lab8 == labf
+            np.testing.assert_array_equal(
+                u8.astype(np.float32).transpose(2, 0, 1), f32)
+
+    def test_decode_pool_persists_across_epochs(self, image_dir):
+        """ISSUE 4 satellite: ONE decode pool for the iterator's lifetime —
+        reset() must not tear it down (rebuilt executors cost a thread-spawn
+        storm per epoch); close() does."""
+        rr = ImageRecordReader(8, 8, 3, ParentPathLabelGenerator())
+        rr.initialize(FileSplit(str(image_dir)))
+        it = ImageRecordReaderDataSetIterator(rr, 4, num_workers=2)
+        list(it)
+        pool = it._pool
+        assert pool is not None  # workers engaged
+        it.reset()
+        assert sum(1 for _ in it) == 3  # second epoch works...
+        assert it._pool is pool  # ...on the SAME pool
+        it.close()
+        assert it._pool is None
+
+    def test_num_workers_defaults_to_cpu_count(self, image_dir):
+        import os
+
+        rr = ImageRecordReader(8, 8, 3, ParentPathLabelGenerator())
+        rr.initialize(FileSplit(str(image_dir)))
+        it = ImageRecordReaderDataSetIterator(rr, 4)
+        assert it.num_workers == (os.cpu_count() or 1)
+
     def test_transform_chain_deterministic_per_seed(self, image_dir):
         chain = PipelineImageTransform([
             ResizeImageTransform(12, 12),

@@ -438,6 +438,49 @@ def test_no_bare_print_in_library_code():
         f"registry): {offenders}")
 
 
+# Fit/ETL hot-path modules: code here may legitimately receive DEVICE-resident
+# arrays (DevicePrefetchIterator batches), where np.asarray is a blocking d2h
+# copy the fit loop immediately re-uploads — the silent round trip the device
+# pipeline exists to remove. Every np.asarray in these files must either be
+# guarded (device arrays pass through first) or carry a `# host-ok:` comment
+# justifying why the buffer is host-side by construction.
+_HOT_PATH_FILES = (
+    "nn/multilayer.py",
+    "nn/graph.py",
+    "parallel/trainer.py",
+    "data/dataset.py",
+    "data/iterators.py",
+)
+
+
+def test_no_unannotated_np_asarray_in_hot_paths():
+    """Repo lint (ISSUE 4 satellite): blocking ``np.asarray(...)`` on a
+    device array inside the fit/ETL hot paths is a silent d2h→h2d round-trip
+    footgun. Static analysis can't prove an argument is host-side, so the
+    rule is: in hot-path modules, every np.asarray call line must carry a
+    ``# host-ok:`` justification (and the guard in data.dataset._to_np keeps
+    device arrays away from the annotated ones)."""
+    root = pathlib.Path(__file__).resolve().parent.parent / "deeplearning4j_tpu"
+    offenders = []
+    for rel in _HOT_PATH_FILES:
+        src = (root / rel).read_text()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "asarray"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("np", "numpy")):
+                continue
+            if "host-ok" not in lines[node.lineno - 1]:
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "np.asarray in a fit/ETL hot path without a `# host-ok:` "
+        "justification (on a device array this is a blocking d2h→h2d round "
+        f"trip — use jnp.asarray / pass device arrays through): {offenders}")
+
+
 def _broad_handler(handler: ast.ExceptHandler) -> bool:
     """Bare ``except:`` or ``except (Base)Exception`` — the handlers that can
     swallow genuine bugs. Narrow handlers (``except (TypeError, ValueError)``)
