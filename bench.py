@@ -86,6 +86,8 @@ def _scale(on_tpu):
             # steps=40: the ~0.6s tunnel sync amortizes to ~15ms/step noise at
             # steps=10 — measured r5, same amortization rationale as resnet
             "bert": dict(batch=16, seq=128, steps=40, warmup=3, tiny=False),
+            "serving": dict(clients=16, requests=320, batch_limit=16,
+                            features=64, classes=8, queue=256),
         }
     return {
         "resnet50": dict(batch=8, hw=64, classes=10, steps=5, warmup=2, pipeline_steps=3),
@@ -93,6 +95,8 @@ def _scale(on_tpu):
         "lstm": dict(batch=8, vocab=32, seqlen=100, tbptt=50, steps=3, warmup=1),
         "w2v": dict(sent=400, layer=32, batch=2048),
         "bert": dict(batch=2, seq=64, steps=3, warmup=1, tiny=True),
+        "serving": dict(clients=4, requests=80, batch_limit=8,
+                        features=16, classes=4, queue=64),
     }
 
 
@@ -558,6 +562,86 @@ def bench_bert(p):
             "model": "tiny" if p["tiny"] else "bert-base"}
 
 
+# ------------------------------------------------------------------- serving
+
+
+def bench_serving(p):
+    """ISSUE 5: serving throughput + tail latency through the full stack —
+    JsonModelClient → HTTP → bounded admission queue → micro-batching
+    executor → ParallelInference bucketed forward. Mean coalesced batch rows
+    come from the tdl_inference_batch_size histogram, so the number reported
+    here is the same thing /metrics exposes in production."""
+    import threading
+
+    from deeplearning4j_tpu.monitoring import get_registry
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving import JsonModelClient, JsonModelServer
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3)).list()
+            .layer(DenseLayer(n_in=p["features"], n_out=128, activation="relu"))
+            .layer(OutputLayer(n_out=p["classes"], activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    warm = np.zeros((1, p["features"]), np.float32)
+    bs = get_registry().get("tdl_inference_batch_size")
+    base = bs.snapshot()["series"][0] if bs and bs.snapshot()["series"] else None
+    server = (JsonModelServer.Builder(net).port(0)
+              .batch_limit(p["batch_limit"]).queue_size(p["queue"])
+              .warmup_input(warm).build().start())
+    ready = server.wait_ready(60.0)
+    if not ready:
+        server.stop()
+        return {"metric": "serving_requests_per_sec", "value": 0.0,
+                "unit": "req/s", "error": "server never became ready"}
+    x = np.random.RandomState(0).randn(1, p["features"]).astype(np.float32).tolist()
+    per_client = p["requests"] // p["clients"]
+    latencies, errors, lock = [], [0], threading.Lock()
+
+    def worker():
+        client = JsonModelClient(port=server.port, retries=3,
+                                 backoff_base=0.02, backoff_max=0.25)
+        mine = []
+        for _ in range(per_client):
+            t0 = time.perf_counter()
+            try:
+                client.predict(x)
+                mine.append(time.perf_counter() - t0)
+            except RuntimeError:
+                with lock:
+                    errors[0] += 1
+        with lock:
+            latencies.extend(mine)
+
+    threads = [threading.Thread(target=worker) for _ in range(p["clients"])]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    server.stop(drain=True)
+
+    latencies.sort()
+    n = len(latencies)
+    series = get_registry().get("tdl_inference_batch_size").snapshot()["series"]
+    snap = series[0] if series else None  # no child if every request failed
+    count = (snap["count"] - (base["count"] if base else 0)) if snap else 0
+    total = (snap["sum"] - (base["sum"] if base else 0)) if snap else 0.0
+    return {
+        "metric": "serving_requests_per_sec",
+        "value": round(n / elapsed, 1) if elapsed else 0.0,
+        "unit": "req/s",
+        "clients": p["clients"], "completed": n, "errors": errors[0],
+        "p50_ms": round(latencies[n // 2] * 1e3, 2) if n else None,
+        "p99_ms": round(latencies[min(n - 1, int(0.99 * n))] * 1e3, 2) if n else None,
+        "mean_batch_rows": round(total / count, 2) if count else None,
+        "batch_limit": p["batch_limit"],
+    }
+
+
 # --------------------------------------------------------------------- driver
 
 
@@ -586,7 +670,7 @@ def _baseline_ratio(backend, value, config):
 
 
 BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
-           "w2v": bench_w2v, "bert": bench_bert}
+           "w2v": bench_w2v, "bert": bench_bert, "serving": bench_serving}
 
 
 def main():

@@ -12,6 +12,13 @@ production preemption would.
     crash@iter=7,rank=1          hard os._exit at train iteration 7 on rank 1
     hang@iter=5,rank=0           wedge (sleep forever) at iteration 5, rank 0
     slow_ckpt_io=2.0             sleep 2.0s inside every checkpoint write
+    slow_infer@p=0.05            sleep 0.05s inside every inference batch
+    fail_infer@n=5               raise InjectedFault on every 5th inference
+
+The serving faults (ISSUE 5) fire at the ``infer`` site inside
+``serving.executor.BatchingInferenceExecutor`` — the same machinery a wedged
+or crashing model forward exercises in production — so the serving chaos
+tests drive real admission-control/deadline/shed paths.
 
 ``crash``/``hang`` clauses fire only in the gang's FIRST incarnation by
 default (``TDL_GANG_RESTART_COUNT=0``), so a supervisor restart replays the
@@ -41,9 +48,14 @@ ENV_RANK = "TDL_PROCESS_ID"
 CRASH_EXIT_CODE = 43
 
 
+class InjectedFault(RuntimeError):
+    """Raised by ``fail_infer`` — a deterministic stand-in for a model-side
+    failure; serving must map it to HTTP 500 like any other model error."""
+
+
 @dataclass
 class Fault:
-    kind: str                     # "crash" | "hang" | "slow_ckpt_io"
+    kind: str   # "crash" | "hang" | "slow_ckpt_io" | "slow_infer" | "fail_infer"
     params: Dict[str, str] = field(default_factory=dict)
 
     @property
@@ -87,7 +99,8 @@ def parse_fault_spec(spec: str) -> List[Fault]:
         else:
             kind, params = clause, {}
         kind = kind.strip()
-        if kind not in ("crash", "hang", "slow_ckpt_io"):
+        if kind not in ("crash", "hang", "slow_ckpt_io", "slow_infer",
+                        "fail_infer"):
             raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
         faults.append(Fault(kind, params))
     return faults
@@ -100,6 +113,7 @@ class FaultInjector:
 
     - ``train_step`` (iteration=N): ``crash`` / ``hang`` clauses
     - ``ckpt_write``: ``slow_ckpt_io`` clauses
+    - ``infer``: ``slow_infer`` / ``fail_infer`` clauses
     """
 
     def __init__(self, faults: List[Fault], rank: Optional[int] = None,
@@ -108,6 +122,7 @@ class FaultInjector:
         self.rank = rank if rank is not None else int(os.environ.get(ENV_RANK, "0"))
         self.incarnation = (incarnation if incarnation is not None
                             else int(os.environ.get(ENV_INCARNATION, "0")))
+        self._infer_calls = 0  # deterministic fail_infer@n= cadence
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
@@ -121,6 +136,8 @@ class FaultInjector:
         return f.fires_in_incarnation(self.incarnation)
 
     def fire(self, site: str, iteration: Optional[int] = None) -> None:
+        if site == "infer":
+            self._infer_calls += 1
         for f in self.faults:
             if site == "train_step" and f.kind in ("crash", "hang"):
                 if not self._matches(f, iteration):
@@ -142,6 +159,22 @@ class FaultInjector:
                 if ("restart" not in f.params
                         or f.fires_in_incarnation(self.incarnation)):
                     time.sleep(f.value)
+            elif site == "infer" and f.kind in ("slow_infer", "fail_infer"):
+                if f.rank is not None and f.rank != self.rank:
+                    continue
+                # like slow_ckpt_io: fires in every incarnation unless pinned
+                if ("restart" in f.params
+                        and not f.fires_in_incarnation(self.incarnation)):
+                    continue
+                if f.kind == "slow_infer":
+                    time.sleep(float(f.params.get("p",
+                                                  f.params.get("value", "0"))))
+                else:
+                    n = int(f.params.get("n", "1"))
+                    if n <= 1 or self._infer_calls % n == 0:
+                        raise InjectedFault(
+                            f"fault injection: fail_infer "
+                            f"(inference call {self._infer_calls})")
 
 
 _cached: Optional[FaultInjector] = None

@@ -15,6 +15,7 @@ from .heartbeat import HeartbeatWriter, maybe_beat, read_heartbeat
 from .listener import MetricsListener
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
+from .serving import serving_metrics
 from .trace import Span, current_span_path, set_trace_profiler, span, step_span
 from .watchdogs import (DeviceMemoryWatchdog, RecompileWatchdog, active,
                         host_rss_bytes, note_signature, note_step,
@@ -26,6 +27,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "get_registry",
+    "serving_metrics",
     "MetricsListener",
     "HeartbeatWriter",
     "maybe_beat",

@@ -1,0 +1,54 @@
+"""Serving metric families — the observable surface of ISSUE 5.
+
+One declaration site so the executor, the HTTP server, tests, and ``bench.py``
+agree on names, labels, and buckets. All families live in the process-wide
+registry by default, so they ride the existing ``UIServer`` ``/metrics``
+exposition and the ``bench.py`` telemetry block with zero extra wiring.
+
+Families::
+
+    tdl_inference_requests_total{code}      HTTP responses by status code
+    tdl_inference_shed_total{reason}        requests refused/abandoned before
+                                            the model ran (queue_full,
+                                            queue_expired, deadline, shutdown)
+    tdl_inference_queue_depth               admission queue depth (gauge)
+    tdl_inference_queue_wait_seconds        time from admission to batching
+    tdl_inference_latency_seconds           end-to-end request latency
+    tdl_inference_batch_size                coalesced rows per executor cycle
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Optional
+
+from .registry import MetricsRegistry, get_registry
+
+#: row-count buckets for the micro-batch size histogram — powers of two to
+#: mirror ParallelInference's bucketed padding
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+def serving_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespace:
+    """Get-or-create the serving metric families on ``registry``."""
+    r = registry if registry is not None else get_registry()
+    return SimpleNamespace(
+        requests=r.counter(
+            "tdl_inference_requests_total",
+            "inference HTTP responses by status code", labels=("code",)),
+        shed=r.counter(
+            "tdl_inference_shed_total",
+            "requests shed before the model ran", labels=("reason",)),
+        queue_depth=r.gauge(
+            "tdl_inference_queue_depth", "inference admission queue depth"),
+        queue_wait=r.histogram(
+            "tdl_inference_queue_wait_seconds",
+            "seconds a request waited in the admission queue"),
+        latency=r.histogram(
+            "tdl_inference_latency_seconds",
+            "end-to-end request latency, admission to response"),
+        batch_size=r.histogram(
+            "tdl_inference_batch_size",
+            "rows coalesced into one inference cycle",
+            buckets=BATCH_SIZE_BUCKETS),
+    )

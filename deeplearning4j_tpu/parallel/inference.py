@@ -56,10 +56,34 @@ class ParallelInference:
         return np.asarray(out.numpy() if hasattr(out, "numpy") else out)[:n]
 
     def output_batched(self, xs: List[np.ndarray]) -> List[np.ndarray]:
-        """Service a list of requests as one padded batch (request batching)."""
-        sizes = [np.asarray(x).shape[0] for x in xs]
-        big = np.concatenate([np.asarray(x) for x in xs], axis=0)
-        out = self.output(big)
+        """Service a list of requests as one padded batch (request batching).
+
+        Inputs are validated up front: an empty list returns ``[]``, and a
+        mixed-dtype or mixed-feature-shape list raises a ``ValueError``
+        naming the offending request index instead of failing deep inside
+        jax's concatenate/trace machinery.
+        """
+        arrs = [np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+                for x in xs]
+        if not arrs:
+            return []
+        for i, a in enumerate(arrs):
+            if a.ndim == 0:
+                raise ValueError(
+                    f"request {i}: scalar input — every request needs a "
+                    f"batch dimension")
+        ref = arrs[0]
+        for i, a in enumerate(arrs[1:], start=1):
+            if a.shape[1:] != ref.shape[1:]:
+                raise ValueError(
+                    f"request {i}: feature shape {a.shape[1:]} does not "
+                    f"match request 0's {ref.shape[1:]}")
+            if a.dtype != ref.dtype:
+                raise ValueError(
+                    f"request {i}: dtype {a.dtype} does not match "
+                    f"request 0's {ref.dtype}")
+        sizes = [a.shape[0] for a in arrs]
+        out = self.output(np.concatenate(arrs, axis=0))
         res, off = [], 0
         for s in sizes:
             res.append(out[off : off + s])

@@ -56,7 +56,7 @@ def free_port() -> int:
     coordinator binds, so a concurrent process can steal the port in the gap —
     callers must treat a coordinator bind failure as retryable
     (:func:`launch` and ``GangSupervisor`` respawn on a fresh port)."""
-    with socket.socket() as s:
+    with socket.socket() as s:  # timeout-ok: bind-only probe, no network I/O
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 

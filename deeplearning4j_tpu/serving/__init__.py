@@ -1,10 +1,26 @@
-"""Model serving: JSON HTTP inference endpoint.
+"""Model serving: production-hardened JSON HTTP inference.
 
 Reference: ``deeplearning4j-remote`` / ``nd4j-remote`` ``JsonModelServer``
 (SURVEY §2.6 S7): HTTP endpoint wrapping MLN/CG/SameDiff (and
 ParallelInference for batching) with typed (de)serializers.
+
+Layered as: ``JsonModelServer`` (HTTP, admission control, deadlines,
+liveness/readiness, graceful drain) over ``BatchingInferenceExecutor``
+(bounded queue, micro-batching, warmup, chaos hooks) over
+``parallel.ParallelInference`` (bucketed padded batches on one sharded
+executable). See docs/PARITY.md "Serving" for the DL4J mapping.
 """
 
+from .executor import (BatchingInferenceExecutor, DeadlineExceededError,
+                       ExecutorClosedError, InferenceFuture, QueueFullError)
 from .json_server import JsonModelServer, JsonModelClient
 
-__all__ = ["JsonModelServer", "JsonModelClient"]
+__all__ = [
+    "JsonModelServer",
+    "JsonModelClient",
+    "BatchingInferenceExecutor",
+    "InferenceFuture",
+    "QueueFullError",
+    "DeadlineExceededError",
+    "ExecutorClosedError",
+]

@@ -1,0 +1,292 @@
+"""BatchingInferenceExecutor — the micro-batching inference core (ISSUE 5).
+
+Reference: ``org.deeplearning4j.parallelism.ParallelInference`` queues
+observations and a worker drains them in batches up to ``batchLimit`` against
+a pool of per-device model replicas (SURVEY §2.6 S5). TPU inversion: ONE
+dedicated inference thread drains a bounded admission queue into
+``ParallelInference``-bucketed padded batches over a single sharded
+executable — the replica pool becomes the mesh, and "batching" keeps the
+executable cache warm instead of keeping replicas busy.
+
+What production hardening adds on top of the DL4J shape:
+
+- **bounded admission**: ``submit`` raises :class:`QueueFullError` when the
+  queue is at capacity — overload becomes explicit backpressure (HTTP 429 at
+  the server layer), never unbounded kernel-socket queueing;
+- **deadlines**: every request carries an absolute deadline; requests that
+  expire while queued are shed WITHOUT running the model (cheap load
+  shedding under overload — the work most worth dropping is work nobody is
+  waiting for anymore);
+- **graceful drain**: ``stop(drain=True)`` refuses new admissions, finishes
+  every accepted request, then stops the thread;
+- **warmup**: an optional example input is run before the first real request
+  so the smallest ParallelInference bucket's XLA executable is compiled at
+  startup, not on the first customer request;
+- **chaos hooks**: ``common.faults.fault_point("infer")`` fires inside the
+  batch cycle (``slow_infer@p=`` / ``fail_infer@n=``), so the serving chaos
+  tests wedge/fail the REAL inference path;
+- **observability**: every queue/batch/shed event lands in the
+  ``tdl_inference_*`` families (``monitoring.serving``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.faults import fault_point
+from ..monitoring.serving import serving_metrics
+
+log = logging.getLogger(__name__)
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity — callers map this to HTTP 429."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed before inference completed (HTTP 504)."""
+
+
+class ExecutorClosedError(RuntimeError):
+    """The executor is stopped or draining — no new admissions (HTTP 503)."""
+
+
+class InferenceFuture:
+    """One accepted request's completion slot.
+
+    Exactly one of ``result`` / ``error`` is populated when ``wait`` returns
+    True. ``deadline`` is an absolute ``time.monotonic()`` instant (None =
+    no deadline).
+    """
+
+    __slots__ = ("x", "deadline", "enqueued_at", "result", "error", "_done",
+                 "abandoned", "_lock")
+
+    def __init__(self, x: np.ndarray, deadline: Optional[float]):
+        self.x = x
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.abandoned = False
+        self._done = threading.Event()
+        self._lock = threading.Lock()  # serializes abandon() vs _expire()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def abandon(self) -> bool:
+        """The waiter gave up (its deadline passed). Returns True when the
+        request is still unresolved — the caller then owns the shed
+        accounting and the executor will not double-count it; False means a
+        result/error landed in the race window and should be consumed."""
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self.abandoned = True
+            return True
+
+    def _expire(self, error: BaseException) -> bool:
+        """Executor-side twin of :meth:`abandon`: resolve with ``error`` and
+        return True iff the executor owns the shed accounting (the waiter
+        had not already claimed it). The shared lock makes exactly one of
+        the two sides the owner."""
+        with self._lock:
+            owns_count = not self.abandoned
+            self._resolve(error=error)
+            return owns_count
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _resolve(self, result: Optional[np.ndarray] = None,
+                 error: Optional[BaseException] = None) -> None:
+        self.result = result
+        self.error = error
+        self._done.set()
+
+
+class BatchingInferenceExecutor:
+    """Bounded-queue micro-batching executor over a model or ParallelInference.
+
+    With ``parallel_inference`` set, coalesced requests run through
+    ``ParallelInference.output_batched`` (padded to a power-of-2 bucket, so
+    the XLA executable cache stays warm across varying concurrency). With a
+    raw ``model``, coalesced requests are concatenated into one forward.
+    Requests are grouped by (dtype, feature-shape) before concatenation so a
+    mixed workload never fails deep inside jax.
+    """
+
+    def __init__(self, model=None, parallel_inference=None, *,
+                 max_queue: int = 64, max_batch_rows: int = 128,
+                 default_deadline_ms: Optional[float] = None,
+                 warmup_input=None, registry=None):
+        if model is None and parallel_inference is None:
+            raise ValueError("need a model or a ParallelInference")
+        self.model = model
+        self.parallel_inference = parallel_inference
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.max_batch_rows = max_batch_rows
+        self.default_deadline_ms = default_deadline_ms
+        self._warmup_input = warmup_input
+        self._m = serving_metrics(registry)
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._accepting = False
+        self._stopping = False
+        self._warm = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "BatchingInferenceExecutor":
+        with self._cv:
+            if self._thread is not None:
+                return self
+            self._accepting = True
+            self._stopping = False
+            self._thread = threading.Thread(
+                target=self._loop, name="tdl-inference", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the inference thread. ``drain=True`` completes every accepted
+        request first; ``drain=False`` cancels queued requests (their futures
+        resolve with :class:`ExecutorClosedError`). Idempotent."""
+        with self._cv:
+            self._accepting = False
+            if self._thread is None:
+                return
+            self._stopping = True
+            if not drain:
+                while self._q:
+                    req = self._q.popleft()
+                    self._m.shed.labels(reason="shutdown").inc()
+                    req._resolve(error=ExecutorClosedError(
+                        "executor stopped before this request ran"))
+                self._m.queue_depth.set(0)
+            self._cv.notify_all()
+            thread = self._thread
+        thread.join(timeout)
+        if thread.is_alive():
+            log.warning("inference thread did not stop within %.1fs", timeout)
+        with self._cv:
+            self._thread = None
+
+    # -- readiness ---------------------------------------------------------
+
+    @property
+    def warm(self) -> bool:
+        """True once the warmup forward (or the first real batch) compiled."""
+        return self._warm.is_set()
+
+    def wait_warm(self, timeout: Optional[float] = None) -> bool:
+        return self._warm.wait(timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, x, deadline_ms: Optional[float] = None) -> InferenceFuture:
+        """Admit one request. Raises :class:`QueueFullError` at capacity,
+        :class:`ExecutorClosedError` when stopped/draining, ``ValueError``
+        on inputs with no batch dimension."""
+        arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+        if arr.ndim == 0:
+            raise ValueError("inference input must have a batch dimension; "
+                             "got a scalar")
+        ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
+        deadline = time.monotonic() + ms / 1000.0 if ms is not None else None
+        fut = InferenceFuture(arr, deadline)
+        with self._cv:
+            if not self._accepting:
+                raise ExecutorClosedError("executor is not accepting requests")
+            if len(self._q) >= self.max_queue:
+                self._m.shed.labels(reason="queue_full").inc()
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue} queued)")
+            self._q.append(fut)
+            self._m.queue_depth.set(len(self._q))
+            self._cv.notify()
+        return fut
+
+    # -- inference thread --------------------------------------------------
+
+    def _loop(self) -> None:
+        if self._warmup_input is not None:
+            try:  # compile the smallest bucket before the first real request
+                self._run([np.asarray(self._warmup_input)])
+            except Exception:
+                log.exception("serving warmup failed — the first request "
+                              "will pay the XLA compile instead")
+        self._warm.set()
+        while True:
+            with self._cv:
+                while not self._q and not self._stopping:
+                    self._cv.wait()
+                if not self._q and self._stopping:
+                    return
+                batch = [self._q.popleft()]
+                rows = batch[0].x.shape[0]
+                while self._q and rows + self._q[0].x.shape[0] <= self.max_batch_rows:
+                    req = self._q.popleft()
+                    rows += req.x.shape[0]
+                    batch.append(req)
+                self._m.queue_depth.set(len(self._q))
+            self._serve_batch(batch)
+
+    def _serve_batch(self, batch: List[InferenceFuture]) -> None:
+        now = time.monotonic()
+        live: List[InferenceFuture] = []
+        for req in batch:
+            self._m.queue_wait.observe(now - req.enqueued_at)
+            if req.deadline is not None and now >= req.deadline:
+                # expired while queued: shed WITHOUT running the model —
+                # nobody is waiting for this answer anymore. An abandoned
+                # request was already counted by its waiter (reason=deadline)
+                if req._expire(DeadlineExceededError(
+                        "deadline expired while queued")):
+                    self._m.shed.labels(reason="queue_expired").inc()
+            else:
+                live.append(req)
+        if not live:
+            return
+        self._m.batch_size.observe(sum(r.x.shape[0] for r in live))
+        groups: Dict[Tuple[str, tuple], List[InferenceFuture]] = {}
+        for req in live:
+            groups.setdefault((str(req.x.dtype), req.x.shape[1:]), []).append(req)
+        for reqs in groups.values():
+            try:
+                fault_point("infer")
+                outs = self._run([r.x for r in reqs])
+            except Exception as e:  # model failure → every rider sees it
+                for r in reqs:
+                    r._resolve(error=e)
+                continue
+            for r, out in zip(reqs, outs):
+                r._resolve(result=out)
+
+    def _run(self, xs: List[np.ndarray]) -> List[np.ndarray]:
+        if self.parallel_inference is not None:
+            return self.parallel_inference.output_batched(xs)
+        big = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+        out = self.model.output(big)
+        arr = np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+        res, off = [], 0
+        for x in xs:
+            res.append(arr[off:off + x.shape[0]])
+            off += x.shape[0]
+        return res

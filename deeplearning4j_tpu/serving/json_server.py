@@ -1,35 +1,96 @@
-"""JsonModelServer — HTTP JSON inference over any model with output().
+"""JsonModelServer — production-hardened HTTP JSON inference (ISSUE 5).
 
 Reference: ``org.deeplearning4j.remote.JsonModelServer`` (SURVEY §2.6 S7):
 POST /predict with a JSON body → typed deserializer → model → serializer →
-JSON response; batching via ParallelInference underneath when provided.
+JSON response, with ``ParallelInference`` underneath for batching (S5).
+
+The happy-path shim (global lock, raw model, unbounded socket queueing) is
+replaced by admission through :class:`BatchingInferenceExecutor`:
+
+- **backpressure**: queue full ⇒ 429 + ``Retry-After`` — overload is shed at
+  admission instead of piling into kernel sockets;
+- **deadlines**: ``X-Deadline-Ms`` header (or the server default) bounds how
+  long a client can wait; expiry ⇒ 504, and requests that expire while still
+  queued never run the model;
+- **liveness vs readiness**: ``/health`` answers 200 while the process
+  serves; ``/ready`` requires the model warm AND the queue below its high
+  watermark, and flips 503 the moment shutdown starts so balancers stop
+  routing before the socket closes;
+- **graceful drain**: ``stop(drain=True)`` completes every accepted request
+  before closing the socket; ``stop`` is idempotent;
+- **restart robustness**: ``SO_REUSEADDR`` (rebind the same port during
+  TIME_WAIT) and a request-body cap (missing ``Content-Length`` or a body
+  over the limit ⇒ 413 — a giant JSON can't OOM the host);
+- **observability**: every response, shed, queue-wait, and batch lands in the
+  ``tdl_inference_*`` metric families.
+
+Status-code contract: 400 = the CALLER's fault (malformed payload — never
+retried), 429/503 = back off and retry (``Retry-After``), 504 = deadline
+exceeded, 500 = model failure (retryable against a replica).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
+
+from ..monitoring.serving import serving_metrics
+from .executor import (BatchingInferenceExecutor, DeadlineExceededError,
+                       ExecutorClosedError, QueueFullError)
+
+log = logging.getLogger(__name__)
+
+#: default per-request deadline — nothing waits forever
+DEFAULT_DEADLINE_MS = 30_000.0
+#: default request-body cap (16 MiB of JSON is already absurd for inference)
+DEFAULT_MAX_BODY_BYTES = 16 << 20
+#: delta-seconds hint sent with 429/503 (RFC 7231 integer seconds)
+RETRY_AFTER_S = 1
 
 
 class JsonModelServer:
     def __init__(self, model, port: int = 0,
                  deserializer: Optional[Callable[[Any], np.ndarray]] = None,
                  serializer: Optional[Callable[[np.ndarray], Any]] = None,
-                 endpoint: str = "/predict"):
+                 endpoint: str = "/predict",
+                 parallel_inference=None, batch_limit: Optional[int] = None,
+                 max_queue: int = 64, max_batch_rows: int = 128,
+                 default_deadline_ms: float = DEFAULT_DEADLINE_MS,
+                 max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
+                 warmup_input=None, registry=None):
         self.model = model
         self.deserializer = deserializer or (lambda d: np.asarray(d, np.float32))
         self.serializer = serializer or (lambda a: np.asarray(a).tolist())
         self.endpoint = endpoint
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.parallel_inference = parallel_inference
+        self.batch_limit = batch_limit
+        self.max_queue = max_queue
+        self.max_batch_rows = max_batch_rows
+        self.default_deadline_ms = default_deadline_ms
+        self.max_body_bytes = max_body_bytes
+        self.warmup_input = warmup_input
+        self.registry = registry
         self.port = port
-        self._lock = threading.Lock()
+        self._m = serving_metrics(registry)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._executor: Optional[BatchingInferenceExecutor] = None
+        self._shutting_down = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
 
     # -- builder parity ----------------------------------------------------
     class Builder:
+        """DL4J ``JsonModelServer.Builder`` parity; ``parallel_inference`` /
+        ``batch_limit`` mirror wiring a ``ParallelInference`` underneath
+        (deliberately dropped DL4J knobs: ``numWorkers`` — the mesh IS the
+        worker pool — and ``inferenceMode``; see docs/PARITY.md)."""
+
         def __init__(self, model):
             self._model = model
             self._kw = {}
@@ -47,98 +108,366 @@ class JsonModelServer:
             self._kw["endpoint"] = e
             return self
 
+        def parallel_inference(self, pi):
+            self._kw["parallel_inference"] = pi
+            return self
+
+        def batch_limit(self, n: int):
+            self._kw["batch_limit"] = n
+            return self
+
+        def queue_size(self, n: int):
+            self._kw["max_queue"] = n
+            return self
+
+        def deadline_ms(self, ms: float):
+            self._kw["default_deadline_ms"] = ms
+            return self
+
+        def max_body_bytes(self, n: int):
+            self._kw["max_body_bytes"] = n
+            return self
+
+        def warmup_input(self, x):
+            self._kw["warmup_input"] = x
+            return self
+
+        def registry(self, r):
+            self._kw["registry"] = r
+            return self
+
         def build(self) -> "JsonModelServer":
             return JsonModelServer(self._model, **self._kw)
 
     def _deserialize(self, payload: Any) -> np.ndarray:
         return self.deserializer(payload)
 
-    def _infer(self, x: np.ndarray) -> Any:
-        with self._lock:  # model state is not re-entrant under donation
-            out = self.model.output(x)
-        arr = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
-        return self.serializer(arr)
+    # -- request handling --------------------------------------------------
 
-    def _predict(self, payload: Any) -> Any:
-        return self._infer(self._deserialize(payload))
+    def _readiness(self) -> Tuple[bool, str]:
+        if self._shutting_down or self._executor is None:
+            return False, "shutting down"
+        if not self._executor.warm:
+            return False, "warming up"
+        high_watermark = max(1, int(round(0.8 * self.max_queue)))
+        depth = self._executor.queue_depth
+        if depth >= high_watermark:
+            return False, (f"queue depth {depth} at/over "
+                           f"high watermark {high_watermark}")
+        return True, ""
+
+    def wait_ready(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._readiness()[0]:
+                return True
+            time.sleep(0.01)
+        return False
+
+    @staticmethod
+    def _discard_body(handler, length: int) -> None:
+        """Drain an unread request body (bounded, chunked) before an early
+        error response: closing the socket with unread data pending makes
+        the kernel RST the connection, the error response never reaches the
+        client, and a retrying client re-uploads the whole body. Bodies past
+        the drain cap are abandoned — RST is then the lesser evil."""
+        remaining = min(length, 64 << 20)
+        try:
+            while remaining > 0:
+                chunk = handler.rfile.read(min(remaining, 65536))
+                if not chunk:
+                    return
+                remaining -= len(chunk)
+        except OSError:
+            log.debug("client stalled while its oversized body was drained")
+
+    def _handle_predict(self, handler) -> Tuple[int, dict, Optional[int]]:
+        """Returns (status, json body, Retry-After seconds or None)."""
+        content_length = handler.headers.get("Content-Length")
+        try:
+            length = int(content_length)
+        except (TypeError, ValueError):
+            length = -1
+        if handler.path != self.endpoint:
+            self._discard_body(handler, max(0, length))
+            return 404, {"error": "unknown endpoint"}, None
+        executor = self._executor
+        if self._shutting_down or executor is None:
+            self._discard_body(handler, max(0, length))
+            return 503, {"error": "server shutting down"}, RETRY_AFTER_S
+        if content_length is None:
+            return 413, {"error": "Content-Length header required"}, None
+        if length < 0:
+            return 400, {"error": f"bad Content-Length {content_length!r}"}, None
+        if length > self.max_body_bytes:
+            self._discard_body(handler, length)
+            return 413, {"error": f"request body {length}B exceeds "
+                                  f"{self.max_body_bytes}B limit"}, None
+        try:
+            body = handler.rfile.read(length)
+        except OSError:
+            # socket read timed out (slowloris: Content-Length promised more
+            # bytes than the client ever sends) — the handler thread must not
+            # wedge holding an _inflight slot
+            return 408, {"error": "timed out reading request body"}, None
+        deadline_ms: Optional[float] = None
+        header = handler.headers.get("X-Deadline-Ms")
+        if header is not None:
+            try:
+                deadline_ms = float(header)
+                if deadline_ms <= 0:
+                    raise ValueError
+            except ValueError:
+                return 400, {"error": f"bad X-Deadline-Ms {header!r}"}, None
+        # 400 = the CALLER's fault (malformed JSON / undecodable payload);
+        # clients retry 5xx against a replica but must not retry a bad payload
+        try:
+            x = self._deserialize(json.loads(body))
+        except Exception as e:
+            return 400, {"error": f"{type(e).__name__}: {e}"}, None
+        try:
+            fut = executor.submit(x, deadline_ms=deadline_ms)
+        except QueueFullError as e:
+            return 429, {"error": str(e)}, RETRY_AFTER_S
+        except ExecutorClosedError as e:
+            return 503, {"error": str(e)}, RETRY_AFTER_S
+        except (ValueError, TypeError) as e:
+            return 400, {"error": f"{type(e).__name__}: {e}"}, None
+        remaining = (None if fut.deadline is None
+                     else fut.deadline - time.monotonic())
+        if not fut.wait(remaining) and fut.abandon():
+            # the executor is still busy; the client's budget is spent —
+            # answer 504 now rather than hang the connection. abandon()
+            # claims the shed accounting so the executor won't also count
+            # this request when it later pops it expired
+            self._m.shed.labels(reason="deadline").inc()
+            return 504, {"error": "deadline exceeded before inference "
+                                  "completed"}, None
+        if fut.error is not None:
+            e = fut.error
+            if isinstance(e, DeadlineExceededError):
+                return 504, {"error": str(e)}, None
+            if isinstance(e, ExecutorClosedError):
+                return 503, {"error": str(e)}, RETRY_AFTER_S
+            return 500, {"error": f"{type(e).__name__}: {e}"}, None
+        try:
+            return 200, {"output": self.serializer(fut.result)}, None
+        except Exception as e:
+            return 500, {"error": f"serializer failed: "
+                                  f"{type(e).__name__}: {e}"}, None
+
+    # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "JsonModelServer":
+        if self._httpd is not None:
+            return self
+        self._shutting_down = False
+        pi = self.parallel_inference
+        if pi is None and self.batch_limit is not None:
+            from ..parallel.inference import ParallelInference
+            pi = ParallelInference(self.model, batch_limit=self.batch_limit)
+            self.parallel_inference = pi
+        self._executor = BatchingInferenceExecutor(
+            model=self.model, parallel_inference=pi,
+            max_queue=self.max_queue, max_batch_rows=self.max_batch_rows,
+            default_deadline_ms=self.default_deadline_ms,
+            warmup_input=self.warmup_input, registry=self.registry).start()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # socket read timeout: a client that stalls mid-request cannot
+            # wedge a handler thread forever (socketserver applies this via
+            # connection.settimeout)
+            timeout = 30.0
+
             def log_message(self, *args):
                 pass
 
-            def _json(self, obj, code=200):
+            def _json(self, obj, code=200, retry_after=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
                 self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    log.debug("client went away before the response landed")
 
             def do_POST(self):
-                if self.path != server.endpoint:
-                    self._json({"error": "unknown endpoint"}, 404)
-                    return
-                # 400 = the CALLER's fault (malformed JSON / undecodable
-                # payload); 500 = OUR fault (model raised) — clients retry
-                # 5xx against a replica but must not retry a bad payload
+                with server._inflight_cv:
+                    server._inflight += 1
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(length))
-                    x = server._deserialize(payload)
-                except Exception as e:
-                    self._json({"error": f"{type(e).__name__}: {e}"}, 400)
-                    return
-                try:  # serving endpoint must not die on a model failure
-                    self._json({"output": server._infer(x)})
-                except Exception as e:
-                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+                    t0 = time.perf_counter()
+                    code, obj, retry_after = server._handle_predict(self)
+                    self._json(obj, code, retry_after)
+                    server._m.requests.labels(code=str(code)).inc()
+                    server._m.latency.observe(time.perf_counter() - t0)
+                finally:
+                    with server._inflight_cv:
+                        server._inflight -= 1
+                        server._inflight_cv.notify_all()
 
             def do_GET(self):
                 if self.path == "/health":
+                    # liveness: the process is up and serving HTTP
                     self._json({"status": "ok"})
+                elif self.path == "/ready":
+                    ready, reason = server._readiness()
+                    if ready:
+                        self._json({"ready": True})
+                    else:
+                        self._json({"ready": False, "reason": reason}, 503,
+                                   retry_after=RETRY_AFTER_S)
                 else:
                     self._json({"error": "POST " + server.endpoint}, 404)
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        class _Httpd(ThreadingHTTPServer):
+            # rebind the same port during TIME_WAIT after a restart
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._httpd = _Httpd(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="tdl-json-server", daemon=True).start()
         return self
 
-    def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop serving. ``drain=True`` completes every accepted in-flight
+        request before the socket closes. Idempotent."""
+        httpd = self._httpd
+        if httpd is None:
+            return
+        # readiness flips 503 first so balancers stop routing while we drain
+        self._shutting_down = True
+        if self._executor is not None:
+            self._executor.stop(drain=drain, timeout=timeout)
+        deadline = time.monotonic() + timeout
+        with self._inflight_cv:
+            while self._inflight and time.monotonic() < deadline:
+                self._inflight_cv.wait(0.05)
+        self._httpd = None
+        httpd.shutdown()
+        httpd.server_close()
 
 
 class JsonModelClient:
-    """Tiny client (nd4j-json-client parity) using stdlib urllib."""
+    """JSON inference client (nd4j-json-client parity) with retry hardening.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 9090, endpoint: str = "/predict"):
+    - capped exponential backoff + full jitter on 429/5xx and on connection
+      errors (refused/reset while a server restarts), honoring the server's
+      ``Retry-After`` hint (capped at ``backoff_max``); other 4xx — a bad
+      payload is the caller's fault — are NEVER retried;
+    - connection errors are normalized to the same ``RuntimeError`` contract
+      as HTTP errors, with the target URL in the message;
+    - a consecutive-failure circuit breaker: after ``breaker_threshold``
+      consecutive 5xx/429/connection failures the client fails fast for
+      ``breaker_cooldown`` seconds, then lets one probe through (half-open).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9090,
+                 endpoint: str = "/predict", timeout: float = 30.0,
+                 retries: int = 3, backoff_base: float = 0.05,
+                 backoff_max: float = 2.0, breaker_threshold: int = 8,
+                 breaker_cooldown: float = 5.0,
+                 deadline_ms: Optional[float] = None):
         self.url = f"http://{host}:{port}{endpoint}"
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self.deadline_ms = deadline_ms
+        self._consecutive_failures = 0
+        self._open_until = 0.0
+        self._breaker_lock = threading.Lock()
 
-    def predict(self, data) -> Any:
+    # -- circuit breaker ---------------------------------------------------
+
+    def _check_breaker(self) -> None:
+        with self._breaker_lock:
+            if self._consecutive_failures >= self.breaker_threshold:
+                now = time.monotonic()
+                if now < self._open_until:
+                    raise RuntimeError(
+                        f"circuit breaker open for {self.url} after "
+                        f"{self._consecutive_failures} consecutive failures; "
+                        f"retrying after cooldown")
+                # half-open: admit THIS call as the single probe and re-arm
+                # the window so concurrent callers keep failing fast until
+                # the probe resolves (no thundering herd on a down server)
+                self._open_until = now + self.breaker_cooldown
+
+    def _record_failure(self) -> None:
+        with self._breaker_lock:
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self.breaker_threshold:
+                self._open_until = time.monotonic() + self.breaker_cooldown
+
+    def _record_success(self) -> None:
+        with self._breaker_lock:
+            self._consecutive_failures = 0
+            self._open_until = 0.0
+
+    def _sleep_backoff(self, attempt: int, retry_after: Optional[str]) -> None:
+        import random
+
+        delay = self.backoff_base * (2 ** attempt) * (0.5 + random.random())
+        if retry_after is not None:
+            try:
+                delay = max(delay, float(retry_after))
+            except ValueError:
+                log.debug("unparseable Retry-After %r ignored", retry_after)
+        time.sleep(min(delay, self.backoff_max))
+
+    # -- request -----------------------------------------------------------
+
+    def predict(self, data, deadline_ms: Optional[float] = None) -> Any:
         import urllib.error
         import urllib.request
 
+        self._check_breaker()
         body = json.dumps(np.asarray(data).tolist()).encode()
-        req = urllib.request.Request(self.url, data=body,
-                                     headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                out = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            # non-2xx raises BEFORE the structured error body is read —
-            # surface the server's JSON error, not a bare "HTTP Error 400"
+        headers = {"Content-Type": "application/json"}
+        ms = deadline_ms if deadline_ms is not None else self.deadline_ms
+        if ms is not None:
+            headers["X-Deadline-Ms"] = str(ms)
+        last_msg = f"no response from {self.url}"
+        for attempt in range(self.retries + 1):
+            retry_after = None
+            req = urllib.request.Request(self.url, data=body, headers=headers)
             try:
-                detail = json.loads(e.read()).get("error", "")
-            except Exception:
-                detail = ""
-            raise RuntimeError(
-                f"server returned HTTP {e.code}: {detail or e.reason}") from None
-        if "error" in out:
-            raise RuntimeError(out["error"])
-        return out["output"]
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    out = json.loads(resp.read())
+                if "error" in out:
+                    raise RuntimeError(out["error"])
+                self._record_success()
+                return out["output"]
+            except urllib.error.HTTPError as e:
+                # non-2xx raises BEFORE the structured error body is read —
+                # surface the server's JSON error, not a bare "HTTP Error 400"
+                try:
+                    detail = json.loads(e.read()).get("error", "")
+                except (ValueError, KeyError, AttributeError):
+                    detail = ""
+                last_msg = f"server returned HTTP {e.code}: {detail or e.reason}"
+                if e.code != 429 and e.code < 500:
+                    # the payload is wrong; retrying cannot fix it
+                    raise RuntimeError(last_msg) from None
+                retry_after = e.headers.get("Retry-After") if e.headers else None
+            except urllib.error.URLError as e:
+                last_msg = f"cannot reach {self.url}: {e.reason}"
+            self._record_failure()
+            if attempt >= self.retries:
+                break
+            with self._breaker_lock:
+                breaker_open = (self._consecutive_failures
+                                >= self.breaker_threshold)
+            if breaker_open:
+                break
+            self._sleep_backoff(attempt, retry_after)
+        raise RuntimeError(last_msg) from None

@@ -481,6 +481,54 @@ def test_no_unannotated_np_asarray_in_hot_paths():
         f"trip — use jnp.asarray / pass device arrays through): {offenders}")
 
 
+def _dotted_name(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def test_no_untimeouted_network_io():
+    """Repo lint (ISSUE 5 satellite): a urllib/socket/http.client call
+    without an explicit timeout hangs the caller forever when the peer
+    wedges — the exact footgun the serving deadline work exists to remove.
+    Library code must pass ``timeout=`` (urlopen / create_connection /
+    HTTPConnection) or justify the site with a ``# timeout-ok:`` comment
+    (raw ``socket.socket`` has no constructor timeout, so it always needs
+    the annotation or a visible ``settimeout``)."""
+    root = pathlib.Path(__file__).resolve().parent.parent / "deeplearning4j_tpu"
+    offenders = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        src = path.read_text()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted_name(node.func)
+            needs_timeout_kw = (
+                name.endswith("urlopen")
+                or name.endswith("create_connection")
+                or name.endswith("HTTPConnection")
+                or name.endswith("HTTPSConnection"))
+            bare_socket = name == "socket.socket" or name.endswith(".socket.socket")
+            if not (needs_timeout_kw or bare_socket):
+                continue
+            if "timeout-ok" in lines[node.lineno - 1]:
+                continue
+            if needs_timeout_kw and any(kw.arg == "timeout"
+                                        for kw in node.keywords):
+                continue
+            offenders.append(f"{rel}:{node.lineno} ({name})")
+    assert not offenders, (
+        "network I/O without an explicit timeout in library code (pass "
+        "timeout=..., or annotate a justified site with `# timeout-ok: "
+        f"<reason>`): {offenders}")
+
+
 def _broad_handler(handler: ast.ExceptHandler) -> bool:
     """Bare ``except:`` or ``except (Base)Exception`` — the handlers that can
     swallow genuine bugs. Narrow handlers (``except (TypeError, ValueError)``)
