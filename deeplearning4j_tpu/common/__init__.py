@@ -1,4 +1,28 @@
-from .dtypes import DataType, promote_types, to_jax, from_jax
-from .environment import Environment
+"""Shared runtime plumbing (dtypes, env flags, faults, precision).
 
-__all__ = ["DataType", "promote_types", "to_jax", "from_jax", "Environment"]
+Light import surface (PEP 562, same policy as the top-level package): the
+dtype helpers pull in jax, which costs ~1s of interpreter startup — but
+spawn-based children (the multi-process ETL workers) import
+``common.environment`` only and must not pay for a jax they never use.
+"""
+
+import importlib as _importlib
+
+_EXPORTS = {
+    "DataType": ".dtypes",
+    "promote_types": ".dtypes",
+    "to_jax": ".dtypes",
+    "from_jax": ".dtypes",
+    "Environment": ".environment",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(_importlib.import_module(mod, __name__), name)
+    globals()[name] = value
+    return value

@@ -74,3 +74,15 @@ class Environment:
 
 def env() -> Environment:
     return Environment.get_instance()
+
+
+def host_cpu_count() -> int:
+    """CPUs actually usable by THIS process: the scheduler affinity mask
+    (what a cgroup/taskset-limited container really has — BENCH_r05 ran with
+    ``host_cpus: 1`` while ``os.cpu_count()`` reported the full machine),
+    falling back to ``os.cpu_count()`` where affinity is unsupported."""
+    try:
+        n = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # non-Linux / restricted
+        n = os.cpu_count() or 1
+    return max(1, n)

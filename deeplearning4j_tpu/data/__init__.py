@@ -1,65 +1,82 @@
-from .dataset import DataSet, MultiDataSet
-from .datasets import (
-    Cifar10DataSetIterator,
-    EmnistDataSetIterator,
-    IrisDataSetIterator,
-    MnistDataSetIterator,
-    TinyImageNetDataSetIterator,
-)
-from .iterators import (
-    DataSetIterator,
-    DevicePrefetchIterator,
-    ListDataSetIterator,
-    ArrayDataSetIterator,
-    AsyncDataSetIterator,
-    MultiDataSetIterator,
-)
-from .normalizers import (
-    ImagePreProcessingScaler,
-    NormalizerMinMaxScaler,
-    NormalizerStandardize,
-    make_device_ingest,
-)
-from .image import (
-    CachedImageDataSetIterator,
-    FrameDirectoryRecordReader,
-    VideoRecordReader,
-    ColorJitterTransform,
-    CropImageTransform,
-    FlipImageTransform,
-    ImageRecordReader,
-    ImageRecordReaderDataSetIterator,
-    ImageTransform,
-    PreDecodedImageCache,
-    ParentPathLabelGenerator,
-    PipelineImageTransform,
-    RandomCropTransform,
-    ResizeImageTransform,
-    RotateImageTransform,
-)
-from .record_reader_iterator import RecordReaderDataSetIterator
-from .records import (
-    ExcelRecordReader,
-    CollectionRecordReader,
-    CSVRecordReader,
-    FileSplit,
-    JacksonLineRecordReader,
-    LineRecordReader,
-    RegexLineRecordReader,
-    SVMLightRecordReader,
-    RecordReader,
-)
-from .transform import (
-    DataQualityAnalysis,
-    Reducer,
-    Schema,
-    SplitMaxLengthSequence,
-    TransformProcess,
-    convert_to_sequence,
-    offset_sequence,
-    reduce_sequence_by_window,
-    split_sequences,
-)
+"""datavec-parity ETL namespace.
+
+Light import surface (PEP 562, same policy as the top-level package): the
+full namespace spans jax-heavy modules (normalizers' device ingest, the
+torch/TF-style dataset wrappers), but the multi-process ETL service's
+spawned workers import only ``etl_service`` + ``dataset`` + ``iterators``
+(numpy-only) — eager package imports would tax every worker spawn ~3s of
+jax startup it never uses. ``from deeplearning4j_tpu.data import X`` still
+works for every name below; the submodule is imported on first use.
+"""
+
+import importlib as _importlib
+
+_EXPORTS = {
+    # dataset containers
+    "DataSet": ".dataset",
+    "MultiDataSet": ".dataset",
+    # curated datasets
+    "Cifar10DataSetIterator": ".datasets",
+    "EmnistDataSetIterator": ".datasets",
+    "IrisDataSetIterator": ".datasets",
+    "MnistDataSetIterator": ".datasets",
+    "TinyImageNetDataSetIterator": ".datasets",
+    # multi-process sharded ETL service
+    "DecodedBatchCache": ".etl_service",
+    "EtlDataSetIterator": ".etl_service",
+    "EtlWorkerError": ".etl_service",
+    "ImageEtlSpec": ".etl_service",
+    "shard_batches": ".etl_service",
+    # iterators
+    "DataSetIterator": ".iterators",
+    "DevicePrefetchIterator": ".iterators",
+    "ListDataSetIterator": ".iterators",
+    "ArrayDataSetIterator": ".iterators",
+    "AsyncDataSetIterator": ".iterators",
+    "MultiDataSetIterator": ".iterators",
+    # normalizers
+    "ImagePreProcessingScaler": ".normalizers",
+    "NormalizerMinMaxScaler": ".normalizers",
+    "NormalizerStandardize": ".normalizers",
+    "make_device_ingest": ".normalizers",
+    # image ETL
+    "CachedImageDataSetIterator": ".image",
+    "FrameDirectoryRecordReader": ".image",
+    "VideoRecordReader": ".image",
+    "ColorJitterTransform": ".image",
+    "CropImageTransform": ".image",
+    "FlipImageTransform": ".image",
+    "ImageRecordReader": ".image",
+    "ImageRecordReaderDataSetIterator": ".image",
+    "ImageTransform": ".image",
+    "PreDecodedImageCache": ".image",
+    "ParentPathLabelGenerator": ".image",
+    "PipelineImageTransform": ".image",
+    "RandomCropTransform": ".image",
+    "ResizeImageTransform": ".image",
+    "RotateImageTransform": ".image",
+    # record readers / splits
+    "RecordReaderDataSetIterator": ".record_reader_iterator",
+    "ExcelRecordReader": ".records",
+    "CollectionRecordReader": ".records",
+    "CSVRecordReader": ".records",
+    "FileSplit": ".records",
+    "JacksonLineRecordReader": ".records",
+    "LineRecordReader": ".records",
+    "RegexLineRecordReader": ".records",
+    "SVMLightRecordReader": ".records",
+    "RecordReader": ".records",
+    # transforms
+    "DataQualityAnalysis": ".transform",
+    "Reducer": ".transform",
+    "Schema": ".transform",
+    "SplitMaxLengthSequence": ".transform",
+    "TransformProcess": ".transform",
+    "convert_to_sequence": ".transform",
+    "offset_sequence": ".transform",
+    "reduce_sequence_by_window": ".transform",
+    "split_sequences": ".transform",
+}
 
 __all__ = [
     "ExcelRecordReader",
@@ -83,6 +100,11 @@ __all__ = [
     "MultiDataSet",
     "DataSetIterator",
     "DevicePrefetchIterator",
+    "DecodedBatchCache",
+    "EtlDataSetIterator",
+    "EtlWorkerError",
+    "ImageEtlSpec",
+    "shard_batches",
     "ListDataSetIterator",
     "ArrayDataSetIterator",
     "AsyncDataSetIterator",
@@ -102,3 +124,12 @@ __all__ = [
     "Schema",
     "TransformProcess",
 ]
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(_importlib.import_module(mod, __name__), name)
+    globals()[name] = value
+    return value

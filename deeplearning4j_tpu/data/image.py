@@ -24,6 +24,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common.environment import host_cpu_count
 from .dataset import DataSet
 from .iterators import DataSetIterator
 from .records import InputSplit, LabeledFileRecordReader
@@ -235,8 +236,10 @@ class ImageRecordReaderDataSetIterator(DataSetIterator):
     ``num_workers`` decodes a batch's images on a thread pool — PIL's decode
     and numpy transforms release the GIL, so this parallelizes like the
     reference's multi-threaded OpenCV ETL; per-image seeded augmentation rng
-    keeps results order-independent. Defaults to ``os.cpu_count()``; pass 0
-    for the synchronous path. The pool is PERSISTENT — rebuilt executors
+    keeps results order-independent. Defaults to ``host_cpu_count()`` — the
+    scheduler-affinity CPU count, so a cgroup-limited host sizes the pool by
+    what it can actually run, not the machine's core count; pass 0 for the
+    synchronous path. The pool is PERSISTENT — rebuilt executors
     cost a thread-spawn storm per epoch (the r5 bench ran decode-starved) —
     and torn down only by ``close()``/GC. Wrap in ``AsyncDataSetIterator``
     (or ``DevicePrefetchIterator``) to additionally overlap whole batches
@@ -250,7 +253,7 @@ class ImageRecordReaderDataSetIterator(DataSetIterator):
         self.batch_size = batch_size
         self._num_classes = num_classes
         self.preprocessor = preprocessor
-        self.num_workers = (os.cpu_count() or 1) if num_workers is None else num_workers
+        self.num_workers = host_cpu_count() if num_workers is None else num_workers
         self._pool = None
 
     @property
@@ -372,7 +375,7 @@ class PreDecodedImageCache:
             mm[i] = arr
 
         if num_workers is None:
-            num_workers = os.cpu_count() or 1
+            num_workers = host_cpu_count()
         if num_workers > 1 and len(files) > 1:
             from concurrent.futures import ThreadPoolExecutor
 

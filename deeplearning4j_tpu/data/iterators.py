@@ -238,23 +238,44 @@ class AsyncDataSetIterator(DataSetIterator):
         self._advance()
         return item
 
-    def reset(self) -> None:
-        if self._thread is not None:
-            # signal stop, then drain whatever is buffered so a worker
-            # blocked in put() can observe the event — O(queue_size), not
-            # O(epoch): the rest of the epoch is never produced
-            self._stop.set()
-            while True:
-                try:
-                    self._queue.get_nowait()
-                except queue.Empty:
-                    if not self._thread.is_alive():
-                        break
-                    time.sleep(self._PUT_POLL_S / 10)
-            self._thread = None
-        self._error = None
+    def _shutdown_worker(self) -> None:
+        """Signal stop, then drain whatever is buffered so a worker blocked
+        in put() can observe the event — O(queue_size), not O(epoch): the
+        rest of the epoch is never produced."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    break
+                time.sleep(self._PUT_POLL_S / 10)
+        self._thread.join(timeout=5.0)
+        self._thread = None
         self._next_item = None
         self._exhausted = False
+
+    def close(self) -> None:
+        """Stop + join the prefetch worker WITHOUT resetting the base (the
+        fit loops call this from a ``finally`` so a mid-epoch exception
+        can't leak the thread until GC). Any buffered batches are dropped; a
+        sticky worker error survives (only ``reset()`` clears it). The
+        iterator stays usable — the worker lazily restarts on next use.
+        Bases that advertise ``restartable_close`` (the multi-process ETL
+        service: its close frees worker PROCESSES and shm, and it resumes
+        deterministically) are closed too; others (e.g. a persistent decode
+        thread pool) are deliberately left alone."""
+        self._shutdown_worker()
+        base_close = getattr(self._base, "close", None)
+        if callable(base_close) and getattr(self._base, "restartable_close",
+                                            False):
+            base_close()
+
+    def reset(self) -> None:
+        self._shutdown_worker()
+        self._error = None
         self._base.reset()
 
     def batch(self) -> int:
@@ -377,10 +398,17 @@ class DevicePrefetchIterator(AsyncDataSetIterator):
         state — so an epoch shorter than the warmup reports 0.0 rather than
         passing queue-fill latency off as starvation. ``epoch_steps`` counts
         this epoch's advances (``wait_seconds`` itself is a bounded recent
-        window)."""
+        window). When the base iterator is the multi-process ETL service
+        (or any base exposing ``etl_stats()``), its ring/cache counters —
+        ``etl_worker_busy_frac``, ``ring_occupancy``, ``cache_hits`` /
+        ``cache_misses`` — are merged in, so one stats() call describes the
+        whole decode → ring → device pipeline."""
         warm = max(0, self._WARMUP_STEPS - (self._steps - len(self.wait_seconds)))
         steady = self.wait_seconds[warm:]
+        base_etl = getattr(self._base, "etl_stats", None)
+        etl = base_etl() if callable(base_etl) else {}
         return {
+            **etl,
             "h2d_bytes": int(self._h2d_bytes.value),
             "h2d_seconds": round(self._h2d_seconds.value, 4),
             "h2d_MBps": round(

@@ -11,6 +11,7 @@
   wires a network's fit loop into the registry.
 """
 
+from .etl import etl_metrics
 from .heartbeat import HeartbeatWriter, maybe_beat, read_heartbeat
 from .listener import MetricsListener
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -27,6 +28,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "get_registry",
+    "etl_metrics",
     "serving_metrics",
     "MetricsListener",
     "HeartbeatWriter",

@@ -298,15 +298,23 @@ class ComputationGraph(_LazyScoreMixin):
 
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(DataSet/MultiDataSet/iterator) or fit(features, labels)."""
-        for _ in range(epochs):
-            if hasattr(data, "__iter__") and not isinstance(data, (DataSet, MultiDataSet, np.ndarray, list, tuple, dict)):
-                for ds in data:
-                    self._fit_one(ds)
-            elif isinstance(data, (DataSet, MultiDataSet)):
-                self._fit_one(data)
-            else:
-                self._fit_batch(self._coerce_inputs(data), self._coerce_labels(labels), None)
-            self.epoch += 1
+        try:
+            for _ in range(epochs):
+                if hasattr(data, "__iter__") and not isinstance(data, (DataSet, MultiDataSet, np.ndarray, list, tuple, dict)):
+                    for ds in data:
+                        self._fit_one(ds)
+                elif isinstance(data, (DataSet, MultiDataSet)):
+                    self._fit_one(data)
+                else:
+                    self._fit_batch(self._coerce_inputs(data), self._coerce_labels(labels), None)
+                self.epoch += 1
+        finally:
+            # join async prefetch workers even when an epoch raises (thread
+            # leak until GC otherwise; ETL bases also free their processes)
+            from ..data.iterators import AsyncDataSetIterator
+
+            if isinstance(data, AsyncDataSetIterator):
+                data.close()
         return self
 
     def _fit_one(self, ds):

@@ -28,7 +28,8 @@ from ..common.precision import amp_enabled, cast_floating, cast_input, compute_d
 from ..monitoring import trace as _trace
 from ..monitoring import watchdogs as _watchdogs
 from ..data.dataset import DataSet
-from ..data.iterators import ArrayDataSetIterator, DataSetIterator, ListDataSetIterator
+from ..data.iterators import (AsyncDataSetIterator, ArrayDataSetIterator,
+                              DataSetIterator, ListDataSetIterator)
 from ..eval.evaluation import Evaluation, RegressionEvaluation
 from ..ndarray.ndarray import NDArray
 from . import conf as conf_mod
@@ -395,13 +396,20 @@ class MultiLayerNetwork(_LazyScoreMixin):
             f = data.numpy() if hasattr(data, "numpy") else np.asarray(data)  # host-ok: fit(features, labels) batches/shuffles host-side
             l = labels.numpy() if hasattr(labels, "numpy") else np.asarray(labels)  # host-ok: see above
             it = ArrayDataSetIterator(f, l, batch_size or f.shape[0])
-        for _ in range(epochs):
-            for ds in it:
-                self._fit_batch(ds)
-            self.epoch += 1
-            for lst in self.listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(self)
+        try:
+            for _ in range(epochs):
+                for ds in it:
+                    self._fit_batch(ds)
+                self.epoch += 1
+                for lst in self.listeners:
+                    if hasattr(lst, "on_epoch_end"):
+                        lst.on_epoch_end(self)
+        finally:
+            # async prefetch wrappers join their worker here, so an exception
+            # mid-epoch can't leak the thread (or the ETL worker PROCESSES a
+            # restart-safe base owns) until GC
+            if isinstance(it, AsyncDataSetIterator):
+                it.close()
         return self
 
     def _train_scan_fn(self, has_fmask: bool, has_lmask: bool):

@@ -140,6 +140,41 @@ class ParallelTrainer:
         return DevicePrefetchIterator(iterator, buffer_size=buffer_size,
                                       sharding=self.batch_sharding())
 
+    # -- sharded ETL (ISSUE 6) ----------------------------------------------
+
+    def _etl_rank_world(self):
+        """(rank, world_size) for per-rank input sharding — single-process
+        trainers own the whole stream; MultiProcessTrainer overrides."""
+        return 0, 1
+
+    #: whether this trainer's ``prefetch()`` wrapper buffers HOST views
+    #: across ``base.next()`` calls. DevicePrefetchIterator stages each
+    #: batch to device inside ``_stage`` BEFORE queueing it, so the shm
+    #: ring view is done with by the time the next slot is released —
+    #: zero-copy is safe. MultiProcessTrainer's plain AsyncDataSetIterator
+    #: queues the raw views (see its override), where zero-copy would let
+    #: workers overwrite still-buffered batches in place.
+    _prefetch_buffers_host_views = False
+
+    def sharded_etl(self, spec, num_workers=None, ring_slots=None,
+                    prefetch: int = 2):
+        """Build this rank's slice of a multi-process ETL pipeline: the spec
+        is re-ranked to THIS trainer's (rank, world_size) — so each gang
+        member's worker pool decodes only its ``rank/world_size`` batches,
+        deterministically across GangSupervisor restarts — and wrapped in
+        the trainer's device prefetcher (``prefetch=0`` returns the bare
+        :class:`~deeplearning4j_tpu.data.etl_service.EtlDataSetIterator`,
+        e.g. to ``set_state`` before fitting). Zero-copy ring views are
+        only handed out when the prefetch wrapper consumes each batch
+        before requesting the next (see ``_prefetch_buffers_host_views``)."""
+        from ..data.etl_service import EtlDataSetIterator
+
+        spec = spec.for_rank(*self._etl_rank_world())
+        zero_copy = not (prefetch and self._prefetch_buffers_host_views)
+        it = EtlDataSetIterator(spec, num_workers=num_workers,
+                                ring_slots=ring_slots, zero_copy=zero_copy)
+        return self.prefetch(it, buffer_size=prefetch) if prefetch else it
+
     # -- fit ----------------------------------------------------------------
 
     def fit(self, iterator, epochs: int = 1, prefetch: int = 0):
@@ -149,10 +184,19 @@ class ParallelTrainer:
         self._place_net()
         if prefetch:
             iterator = self.prefetch(iterator, buffer_size=prefetch)
-        for _ in range(epochs):
-            for ds in iterator:
-                self._fit_batch(ds)
-            self.net.epoch += 1
+        try:
+            for _ in range(epochs):
+                for ds in iterator:
+                    self._fit_batch(ds)
+                self.net.epoch += 1
+        finally:
+            # join async prefetch workers even when a step raises — a
+            # crashed rank must not leak the staging thread (or a restart-
+            # safe ETL base's worker processes) until GC
+            from ..data.iterators import AsyncDataSetIterator
+
+            if isinstance(iterator, AsyncDataSetIterator):
+                iterator.close()
         return self.net
 
     def _fit_batch(self, ds: DataSet):
@@ -259,6 +303,16 @@ class MultiProcessTrainer(ParallelTrainer):
         from ..data.iterators import AsyncDataSetIterator
 
         return AsyncDataSetIterator(iterator, queue_size=buffer_size)
+
+    # the Async wrapper above queues RAW host batches across base.next()
+    # calls — an ETL ring view buffered there could be overwritten in place
+    # by a fast worker, so sharded_etl must hand out copies
+    _prefetch_buffers_host_views = True
+
+    def _etl_rank_world(self):
+        import jax
+
+        return jax.process_index(), jax.process_count()
 
     def _fit_batch(self, ds: DataSet):
         # the single-process remainder fallback cannot cross process

@@ -25,6 +25,8 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import time
+
 import numpy as np
 import pytest
 
@@ -37,3 +39,49 @@ def _seeded_rng():
     set_seed(12345)
     np.random.seed(12345)
     yield
+
+
+_SHM_DIR = "/dev/shm"
+
+
+def _tdl_shm_segments():
+    try:
+        return {n for n in os.listdir(_SHM_DIR) if n.startswith("tdl_")}
+    except OSError:  # non-Linux: no visible shm namespace to audit
+        return set()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_children_or_shm():
+    """ISSUE 6 satellite: fail any test that leaves live child processes
+    (multiprocessing workers — e.g. an ETL service that wasn't closed) or
+    shared-memory segments behind. Leaks are cleaned up after the failure is
+    recorded so one offender can't cascade into the rest of the suite."""
+    import multiprocessing as mp
+
+    before = _tdl_shm_segments()
+    yield
+    leaked_procs = []
+    children = mp.active_children()  # also reaps finished children
+    if children:
+        deadline = time.monotonic() + 3.0  # grace: normal teardown in flight
+        for p in children:
+            p.join(timeout=max(0.0, deadline - time.monotonic()))
+        leaked_procs = [p.name for p in children if p.is_alive()]
+        for p in children:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=2.0)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=2.0)
+    leaked_shm = _tdl_shm_segments() - before
+    for name in leaked_shm:  # unlink so later tests start clean
+        try:
+            os.unlink(os.path.join(_SHM_DIR, name))
+        except OSError:  # already gone: the owner raced our cleanup
+            pass
+    assert not leaked_procs and not leaked_shm, (
+        f"test leaked live child processes {leaked_procs} and/or "
+        f"shared-memory segments {sorted(leaked_shm)} — close() the ETL "
+        "service / iterator (fit loops do it in their finally)")

@@ -224,6 +224,74 @@ def supervised_train():
                   "global_devices": jax.device_count()})
 
 
+def etl_train():
+    """ISSUE 6 acceptance target: per-rank SHARDED multi-process ETL feeding
+    a 2-rank data-parallel gang under GangSupervisor. Each rank's ETL
+    service decodes only its ``rank/world`` slice of the batch stream;
+    checkpoints carry the iterator position (``TrainingCheckpointer.save(
+    net, iterator)``), so a restarted gang replays the exact surviving
+    stream — the parent asserts exact param parity with an unfaulted gang
+    plus per-step batch-hash equality."""
+    import hashlib
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.etl_service import (EtlDataSetIterator,
+                                                     ImageEtlSpec)
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.trainer import MultiProcessTrainer
+    from deeplearning4j_tpu.serde.checkpoint import TrainingCheckpointer
+
+    col = ProcessCollectives()
+    rank, world = col.rank, col.world
+    total_steps = int(os.environ.get("TDL_MP_STEPS", "8"))
+    every = int(os.environ.get("TDL_MP_CKPT_EVERY", "2"))
+    incarnation = int(os.environ.get("TDL_GANG_RESTART_COUNT", "0"))
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.05)).list()
+            .layer(DenseLayer(n_in=24 * 24 * 3, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(24 * 24 * 3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    spec = ImageEtlSpec.from_directory(
+        os.environ["TDL_ETL_DIR"], 24, 24, batch_size=8, store_pad=8,
+        cache_dir=os.environ.get("TDL_ETL_CACHE")).for_rank(rank, world)
+    it = EtlDataSetIterator(spec, num_workers=2, zero_copy=False)
+    ck = TrainingCheckpointer(os.environ["TDL_MP_CKPT"], async_write=False)
+    start = 0
+    if ck.restore(net, it):  # also restores the iterator position
+        start = int(net.iteration)
+    trainer = MultiProcessTrainer(net, build_mesh(data=-1))
+    step_hashes = {}
+    try:
+        for step in range(start, total_steps):
+            if not it.has_next():
+                it.reset()  # epoch boundary: stream continues seamlessly
+            ds = it.next()
+            step_hashes[str(step)] = hashlib.sha256(
+                ds.features.tobytes() + ds.labels.tobytes()).hexdigest()
+            x = (ds.features.reshape(ds.features.shape[0], -1)
+                 .astype(np.float32) / 255.0)
+            trainer.fit([DataSet(x, ds.labels)])
+            if (step + 1) % every == 0:
+                col.barrier(f"ck-{step}")
+                ck.save(net, it)
+                col.barrier(f"ck-done-{step}")
+    finally:
+        it.close()
+
+    flat = np.asarray(net.params().numpy(), np.float64)
+    _write(rank, {"param_sum": float(flat.sum()),
+                  "param_tail": [float(v) for v in flat[-8:]],
+                  "step_hashes": step_hashes, "start": start,
+                  "incarnation": incarnation})
+
+
 def w2v_shard_train():
     """Cross-process embedding-shard training (SURVEY §2.2 J17 / §2.6 S6):
     syn0/syn1 rows shard over a GLOBAL mesh spanning both processes; the

@@ -1,0 +1,639 @@
+"""Multi-process sharded ETL service tests (ISSUE 6).
+
+Covers the tentpole acceptance surface: zero-copy shared-memory ring handoff
+(no batch payload pickling), per-rank shard disjointness + union
+completeness across world sizes, cross-process exception propagation with
+the original traceback (sticky until reset), worker-death respawn,
+persistent decoded-batch cache hits, deterministic replay across a
+simulated restart (``state()``/``set_state()``), and fit-loop ``finally``
+worker cleanup. The full 2-process GangSupervisor restart parity run is
+slow-marked with the rest of the chaos tier.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.etl_service import (
+    EtlDataSetIterator,
+    EtlWorkerError,
+    ImageEtlSpec,
+    shard_batches,
+)
+from deeplearning4j_tpu.data.iterators import (
+    AsyncDataSetIterator,
+    DevicePrefetchIterator,
+)
+from deeplearning4j_tpu.monitoring.registry import MetricsRegistry
+
+WORKERS = os.path.join(os.path.dirname(__file__), "mp_workers.py")
+
+
+@pytest.fixture(scope="module")
+def image_dir(tmp_path_factory):
+    """32 tiny JPEGs in 4 class dirs → 4 batches of 8 at batch_size=8."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("etl_imgs")
+    rs = np.random.RandomState(0)
+    for i in range(32):
+        d = root / f"c{i % 4}"
+        d.mkdir(exist_ok=True)
+        Image.fromarray(rs.randint(0, 255, (40, 40, 3), dtype=np.uint8)).save(
+            str(d / f"i{i:02d}.jpg"), quality=85)
+    return str(root)
+
+
+def _spec(image_dir, **kw):
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("store_pad", 8)
+    return ImageEtlSpec.from_directory(image_dir, 24, 24, **kw)
+
+
+def _drain(it, copy=True):
+    out = []
+    it.reset()
+    while it.has_next():
+        ds = it.next()
+        out.append((ds.features.copy() if copy else ds.features,
+                    ds.labels.copy() if copy else ds.labels))
+    return out
+
+
+# ------------------------------------------------------------------ sharding
+
+
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_shard_disjoint_and_union_complete(world):
+    """Per-rank shards partition the global batch set: pairwise disjoint,
+    and (unequalized) their union covers every batch."""
+    M = 13
+    shards = [shard_batches(M, r, world, equalize=False) for r in range(world)]
+    flat = [b for s in shards for b in s]
+    assert len(flat) == len(set(flat)) == M          # disjoint + complete
+    assert sorted(flat) == list(range(M))
+    # equalized: still disjoint, every rank the same length (lockstep gangs)
+    eq = [shard_batches(M, r, world) for r in range(world)]
+    assert len({len(s) for s in eq}) == 1
+    assert len(eq[0]) == M // world
+    for r, s in enumerate(eq):
+        assert s == shards[r][: M // world]           # deterministic prefix
+
+
+def test_shard_deterministic_across_calls():
+    assert shard_batches(100, 3, 4) == shard_batches(100, 3, 4)
+    with pytest.raises(ValueError):
+        shard_batches(10, 4, 4)
+
+
+def test_sharded_specs_cover_stream(image_dir):
+    """Union of every rank's (unequalized) batch indices == the single-rank
+    stream; per-rank batches decode to the SAME pixels as the world-1 run."""
+    spec1 = _spec(image_dir)
+    world = 2
+    per_rank = [spec1.for_rank(r, world) for r in range(world)]
+    covered = sorted(b for s in per_rank
+                     for b in shard_batches(s.num_batches, s.rank,
+                                            s.world_size, equalize=False))
+    assert covered == list(range(spec1.num_batches))
+    # batch b decodes identically no matter which rank's spec produces it
+    b = 1
+    a, la, _ = per_rank[b % world].produce(b, epoch=0, cache=None)
+    ref, lr, _ = spec1.produce(b, epoch=0, cache=None)
+    np.testing.assert_array_equal(a, ref)
+    np.testing.assert_array_equal(la, lr)
+
+
+# ----------------------------------------------------------- ring + zero-copy
+
+
+def test_ring_zero_copy_no_payload_pickling(image_dir):
+    """Acceptance: the ring handoff adds ZERO payload pickling — every batch
+    the consumer sees is a live VIEW into the shared-memory ring (the pixels
+    crossed the process boundary in place), and the only pickled traffic is
+    the spawn-time spec."""
+    it = EtlDataSetIterator(_spec(image_dir), num_workers=2,
+                            registry=MetricsRegistry())
+    try:
+        it.reset()
+        seen = 0
+        while it.has_next():
+            ds = it.next()
+            assert ds.features.dtype == np.uint8
+            assert ds.features.shape == (8, 24, 24, 3)
+            assert np.shares_memory(ds.features, it.ring_payload_view()), \
+                "batch is a copy, not a shm ring view"
+            assert ds.labels.shape == (8, it.num_classes)
+            seen += 1
+        assert seen == it.epoch_batches == 4
+    finally:
+        it.close()
+
+
+def test_epoch_stream_deterministic_and_augment_varies_by_epoch(image_dir):
+    spec = _spec(image_dir)
+    it = EtlDataSetIterator(spec, num_workers=2, registry=MetricsRegistry(),
+                            zero_copy=False)
+    try:
+        e0 = _drain(it)
+        e1 = _drain(it)
+    finally:
+        it.close()
+    it2 = EtlDataSetIterator(spec, num_workers=1, registry=MetricsRegistry(),
+                             zero_copy=False)
+    try:
+        r0 = _drain(it2)
+        r1 = _drain(it2)
+    finally:
+        it2.close()
+    # same stream regardless of worker count — per-(seed, epoch, batch)
+    # seeding makes production order-independent
+    for (a, la), (b, lb) in zip(e0 + e1, r0 + r1):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+    # augmentation differs across epochs (same composition, new crops/flips)
+    assert any(not np.array_equal(a[0], b[0]) for (a, _), (b, _) in zip(e0, e1))
+
+
+def test_zero_copy_view_valid_until_next_next(image_dir):
+    """The documented zero-copy lifetime: a view stays intact until the
+    FOLLOWING next() call (the slot is only released then)."""
+    it = EtlDataSetIterator(_spec(image_dir), num_workers=1, ring_slots=2,
+                            registry=MetricsRegistry())
+    try:
+        it.reset()
+        first = it.next().features
+        snap = first.copy()
+        time.sleep(0.3)  # workers race ahead into other slots meanwhile
+        np.testing.assert_array_equal(first, snap)
+    finally:
+        it.close()
+
+
+# ------------------------------------------------------- failure propagation
+
+
+def test_worker_exception_surfaces_with_traceback_sticky_until_reset(image_dir):
+    spec = _spec(image_dir, shuffle=False)
+    files = list(spec.files)
+    files[3] = os.path.join(image_dir, "missing.jpg")  # poisons batch 0
+    bad = dataclasses.replace(spec, files=tuple(files))
+    it = EtlDataSetIterator(bad, num_workers=2, registry=MetricsRegistry())
+    try:
+        it.reset()
+        with pytest.raises(EtlWorkerError) as ei:
+            while it.has_next():
+                it.next()
+        # the ORIGINAL worker-side traceback text crossed the boundary
+        assert "FileNotFoundError" in str(ei.value)
+        assert "missing.jpg" in str(ei.value)
+        assert "decode_store_batch" in ei.value.traceback_text
+        # sticky: every subsequent call re-raises until reset()
+        with pytest.raises(EtlWorkerError):
+            it.has_next()
+        with pytest.raises(EtlWorkerError):
+            it.next()
+        it.reset()  # clears the error and restarts the epoch
+        assert it.has_next()
+    finally:
+        it.close()
+
+
+def test_dead_worker_respawns_and_stream_stays_exact(image_dir):
+    """A worker killed hard (no error report) is detected and respawned at
+    its next unpublished position; the consumed stream is byte-identical to
+    an unfaulted run and the respawn is counted."""
+    spec = _spec(image_dir)
+    reg = MetricsRegistry()
+    it = EtlDataSetIterator(spec, num_workers=2, registry=reg,
+                            zero_copy=False)
+    try:
+        it.reset()
+        got = [it.next()]
+        os.kill(it._workers[0].proc.pid, signal.SIGKILL)
+        while it.has_next():
+            got.append(it.next())
+        assert it.etl_stats()["worker_respawns"] == 1
+        assert reg.get("tdl_etl_worker_respawns_total").value == 1
+    finally:
+        it.close()
+    ref_it = EtlDataSetIterator(spec, num_workers=1,
+                                registry=MetricsRegistry(), zero_copy=False)
+    try:
+        ref = _drain(ref_it)
+    finally:
+        ref_it.close()
+    assert len(got) == len(ref) == 4
+    for ds, (f, l) in zip(got, ref):
+        np.testing.assert_array_equal(ds.features, f)
+        np.testing.assert_array_equal(ds.labels, l)
+
+
+# ------------------------------------------------------------ decoded cache
+
+
+def test_persistent_cache_skips_decode_on_second_epoch(image_dir, tmp_path):
+    spec = _spec(image_dir, cache_dir=str(tmp_path / "cache"))
+    reg = MetricsRegistry()
+    it = EtlDataSetIterator(spec, num_workers=2, registry=reg,
+                            zero_copy=False)
+    try:
+        e0 = _drain(it)
+        # let producers finish anything in flight, then read the counters
+        e1 = _drain(it)
+    finally:
+        it.close()
+    stats = it.etl_stats()
+    assert stats["cache_misses"] <= spec.num_batches  # epoch 0 decodes once
+    assert stats["cache_hits"] >= spec.num_batches    # epoch ≥2 hits
+    assert reg.get("tdl_etl_cache_hits_total").value == stats["cache_hits"]
+    # a RESTARTED service (fresh processes) reuses the cache AND reproduces
+    # the exact stream
+    reg2 = MetricsRegistry()
+    it2 = EtlDataSetIterator(spec, num_workers=1, registry=reg2,
+                             zero_copy=False)
+    try:
+        r0 = _drain(it2)
+    finally:
+        it2.close()
+    assert it2.etl_stats()["cache_misses"] == 0
+    assert it2.etl_stats()["cache_hits"] >= spec.num_batches
+    for (a, la), (b, lb) in zip(e0, r0):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+    assert len(e1) == len(e0)
+
+
+def test_cache_stale_lock_reclaimed_not_wedged(image_dir, tmp_path):
+    """A creation winner SIGKILLed before meta.json lands (the gang-teardown
+    chaos model) must not poison the cache dir: the stale lock is reclaimed
+    and the next comer builds the cache."""
+    spec = _spec(image_dir, cache_dir=str(tmp_path))
+    d = os.path.join(str(tmp_path), spec.fingerprint())
+    os.makedirs(d)
+    lock = os.path.join(d, ".lock")
+    with open(lock, "w"):
+        pass
+    old = time.time() - 120.0  # well past the staleness horizon
+    os.utime(lock, (old, old))
+    cache = spec.open_cache()  # reclaims the dead winner's lock + builds
+    assert cache.done_count() == 0
+    assert not os.path.exists(lock)
+    assert os.path.exists(os.path.join(d, "meta.json"))
+
+
+def test_cache_key_changes_with_etl_config(image_dir, tmp_path):
+    a = _spec(image_dir, cache_dir=str(tmp_path))
+    b = dataclasses.replace(a, store_pad=4)
+    c = dataclasses.replace(a, seed=a.seed + 1)
+    assert a.fingerprint() == _spec(image_dir, cache_dir=str(tmp_path)).fingerprint()
+    assert len({a.fingerprint(), b.fingerprint(), c.fingerprint()}) == 3
+    # distinct configs land in distinct subdirectories — no cross-pollution
+    ca, cb = a.open_cache(), b.open_cache()
+    assert ca.dir != cb.dir
+
+
+# ------------------------------------------------- restart replay (state)
+
+
+def test_set_state_resumes_exact_stream_after_close(image_dir):
+    """The GangSupervisor restart contract in miniature: consume part of the
+    stream, tear the service down (the 'crash'), rebuild from state() — the
+    combined stream is byte-identical to an uninterrupted run, INCLUDING
+    through the __iter__ protocol's leading reset()."""
+    spec = _spec(image_dir)
+    ref_it = EtlDataSetIterator(spec, num_workers=2,
+                                registry=MetricsRegistry(), zero_copy=False)
+    try:
+        ref = _drain(ref_it) + _drain(ref_it)  # two epochs
+    finally:
+        ref_it.close()
+
+    it = EtlDataSetIterator(spec, num_workers=2, registry=MetricsRegistry(),
+                            zero_copy=False)
+    got = []
+    try:
+        it.reset()
+        for _ in range(3):  # through the epoch boundary would be pos 4
+            ds = it.next()
+            got.append((ds.features, ds.labels))
+        state = it.state()
+    finally:
+        it.close()
+    assert state == {"epoch": 0, "pos": 3}
+
+    it2 = EtlDataSetIterator(spec, num_workers=1, registry=MetricsRegistry(),
+                             zero_copy=False)
+    try:
+        it2.set_state(state)
+        # the for-protocol fires reset() first — must NOT rewind the resume
+        for ds in it2:
+            got.append((ds.features, ds.labels))
+        for ds in it2:  # next epoch
+            got.append((ds.features, ds.labels))
+    finally:
+        it2.close()
+    assert len(got) == len(ref)
+    for (a, la), (b, lb) in zip(got, ref):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_set_state_at_epoch_boundary_resumes_without_leading_reset(image_dir):
+    """Regression: a checkpoint taken exactly at an epoch boundary restores
+    to (epoch e, pos 0); the worker consume pattern (`if not has_next():
+    reset()` with NO leading reset) must flow into epoch e and the boundary
+    reset into e+1 — the resume guard must not swallow the boundary."""
+    spec = _spec(image_dir)
+    ref_it = EtlDataSetIterator(spec, num_workers=1,
+                                registry=MetricsRegistry(), zero_copy=False)
+    try:
+        _drain(ref_it)           # epoch 0
+        ref_e1 = _drain(ref_it)  # epoch 1
+        ref_e2 = _drain(ref_it)  # epoch 2
+    finally:
+        ref_it.close()
+    it = EtlDataSetIterator(spec, num_workers=1, registry=MetricsRegistry(),
+                            zero_copy=False)
+    got = []
+    try:
+        it.set_state({"epoch": 1, "pos": 0})
+        for _ in range(2 * it.epoch_batches):  # epoch 1 THROUGH epoch 2
+            if not it.has_next():
+                it.reset()
+            ds = it.next()
+            got.append((ds.features, ds.labels))
+        assert it.state() == {"epoch": 3, "pos": 0}
+    finally:
+        it.close()
+    for (a, la), (b, lb) in zip(got, ref_e1 + ref_e2):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_fit_replay_param_parity_after_simulated_restart(image_dir):
+    """Param-parity acceptance, single-process: train on the ETL stream,
+    'crash' mid-epoch (close + rebuild from state), finish — final params
+    exactly match the unfaulted run."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import DataSetIterator
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    spec = _spec(image_dir)
+
+    class _Flat(DataSetIterator):
+        """uint8 NHWC → flat float batches for a toy dense net."""
+
+        def __init__(self, base):
+            self.base = base
+
+        def has_next(self):
+            return self.base.has_next()
+
+        def reset(self):
+            self.base.reset()
+
+        def batch(self):
+            return self.base.batch()
+
+        def next(self):
+            ds = self.base.next()
+            x = ds.features.reshape(ds.features.shape[0], -1)
+            return DataSet(x.astype(np.float32) / 255.0, ds.labels)
+
+    def net():
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.05))
+                .list()
+                .layer(DenseLayer(n_in=24 * 24 * 3, n_out=16,
+                                  activation="tanh"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(24 * 24 * 3))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def params(n):
+        return np.asarray(n.params().numpy(), np.float64)
+
+    # unfaulted reference: one full epoch
+    ref_net = net()
+    ref_it = EtlDataSetIterator(spec, num_workers=2,
+                                registry=MetricsRegistry(), zero_copy=False)
+    try:
+        ref_net.fit(_Flat(ref_it))
+    finally:
+        ref_it.close()
+    ref = params(ref_net)
+
+    # faulted run: crash after 2 batches, restore, resume from state
+    n2 = net()
+    it = EtlDataSetIterator(spec, num_workers=2, registry=MetricsRegistry(),
+                            zero_copy=False)
+    try:
+        it.reset()
+        for _ in range(2):
+            ds = _Flat(it).next()
+            n2._fit_batch(ds)
+        state = it.state()
+    finally:
+        it.close()  # the crash
+    it2 = EtlDataSetIterator(spec, num_workers=1, registry=MetricsRegistry(),
+                             zero_copy=False)
+    try:
+        it2.set_state(state)
+        n2.fit(_Flat(it2))  # __iter__ reset keeps the resume position
+    finally:
+        it2.close()
+    np.testing.assert_array_equal(params(n2), ref)
+
+
+# ----------------------------------------------------- fit-loop worker hygiene
+
+
+class _Boom(Exception):
+    pass
+
+
+def test_fit_closes_async_workers_on_midepoch_exception():
+    """ISSUE 6 satellite: an exception mid-epoch must not leak the prefetch
+    worker thread until GC — fit's finally joins it."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    rs = np.random.RandomState(0)
+    sets = [DataSet(rs.rand(4, 6).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rs.randint(0, 3, 4)])
+            for _ in range(50)]
+
+    class _Poison(ListDataSetIterator):
+        def next(self):
+            ds = super().next()
+            if self._pos == 3:
+                raise _Boom("etl blows up mid-epoch")
+            return ds
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    nets = MultiLayerNetwork(conf).init()
+    before = {t.ident for t in threading.enumerate()}
+    it = AsyncDataSetIterator(_Poison(sets), queue_size=2)
+    with pytest.raises(_Boom):
+        nets.fit(it)
+    assert it._thread is None  # joined by fit's finally, not leaked to GC
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in before and t.is_alive()]
+    assert not leaked, leaked
+
+
+def test_etl_iterator_resumes_after_fit_finally_close(image_dir):
+    """The fit-loop close must not lose the stream: EtlDataSetIterator is
+    restart-safe — close() then continued consumption resumes at the same
+    position with fresh worker processes."""
+    it = EtlDataSetIterator(_spec(image_dir), num_workers=1,
+                            registry=MetricsRegistry(), zero_copy=False)
+    try:
+        it.reset()
+        a = it.next().features
+        it.close()           # what a fit finally does
+        assert not it._started
+        b = it.next().features  # lazy respawn, next position
+        assert not np.array_equal(a, b)
+        assert it.state() == {"epoch": 0, "pos": 2}
+    finally:
+        it.close()
+
+
+def test_trainer_sharded_etl_wiring(image_dir):
+    """ParallelTrainer.sharded_etl re-ranks the spec to the trainer's
+    (rank, world) and wraps it in the mesh-sharded device prefetcher."""
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_in=4, n_out=4, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    trainer = ParallelTrainer(MultiLayerNetwork(conf).init(),
+                              build_mesh(data=-1))
+    spec = _spec(image_dir).for_rank(3, 7)  # stale placement gets replaced
+    pre = trainer.sharded_etl(spec, num_workers=1)
+    assert isinstance(pre, DevicePrefetchIterator)
+    assert pre._base.spec.rank == 0 and pre._base.spec.world_size == 1
+    assert pre._sharding is not None  # one-shot mesh placement wired
+    # DevicePrefetchIterator stages to device before queueing → the shm
+    # ring view's lifetime contract holds and zero-copy stays on
+    assert pre._base.zero_copy
+    pre.close()  # lazy service: nothing spawned, close is a no-op
+    bare = trainer.sharded_etl(spec, num_workers=1, prefetch=0)
+    assert isinstance(bare, EtlDataSetIterator)
+    bare.close()
+
+
+def test_multiprocess_trainer_sharded_etl_copies_out_of_ring(image_dir):
+    """MultiProcessTrainer's prefetch wrapper is a plain AsyncDataSetIterator
+    that BUFFERS host batches across base.next() calls — a zero-copy ring
+    view queued there could be overwritten in place by a fast worker, so
+    sharded_etl must hand out copies on that path (and may stay zero-copy
+    for the unbuffered prefetch=0 path)."""
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.trainer import MultiProcessTrainer
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_in=4, n_out=4, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    mpt = MultiProcessTrainer(MultiLayerNetwork(conf).init(),
+                              build_mesh(data=-1))
+    pre = mpt.sharded_etl(_spec(image_dir), num_workers=1)
+    assert isinstance(pre, AsyncDataSetIterator)
+    assert pre._base.zero_copy is False  # buffered host views ⇒ copies
+    pre.close()
+    bare = mpt.sharded_etl(_spec(image_dir), num_workers=1, prefetch=0)
+    assert bare.zero_copy is True  # unbuffered direct consumption: safe
+    bare.close()
+
+
+def test_async_close_propagates_to_restartable_base(image_dir):
+    it = EtlDataSetIterator(_spec(image_dir), num_workers=1,
+                            registry=MetricsRegistry())
+    pre = DevicePrefetchIterator(it, buffer_size=2,
+                                 registry=MetricsRegistry())
+    assert pre.has_next()
+    assert it._started
+    pre.close()
+    assert not it._started  # ETL worker processes + shm released too
+
+
+# ---------------------------------------------------- gang restart (slow tier)
+
+
+@pytest.mark.slow
+def test_gang_restart_replays_sharded_etl_with_param_parity(image_dir,
+                                                            tmp_path):
+    """Acceptance: per-rank sharded ETL replays deterministically across a
+    GangSupervisor restart — a crash-injected 2-rank gang finishes
+    unattended with final params EXACTLY matching the unfaulted gang."""
+    from deeplearning4j_tpu.parallel import GangSupervisor, launcher
+
+    def run(fault, sub):
+        out = str(tmp_path / sub / "out.json")
+        os.makedirs(str(tmp_path / sub), exist_ok=True)
+        env = {"TDL_MP_OUT": out,
+               "TDL_MP_CKPT": str(tmp_path / sub / "ckpt"),
+               "TDL_ETL_DIR": image_dir,
+               "TDL_ETL_CACHE": str(tmp_path / "shared_cache"),
+               "TDL_MP_CKPT_EVERY": "2",
+               "TDL_MATMUL_PRECISION": "float32"}
+        os.makedirs(env["TDL_MP_CKPT"], exist_ok=True)
+        if fault:
+            env["TDL_FAULT_SPEC"] = fault
+        sup = GangSupervisor(f"{WORKERS}:etl_train", n_processes=2,
+                             n_local_devices=2, extra_env=env,
+                             workdir=str(tmp_path / sub / "gang"),
+                             heartbeat_interval=0.0, backoff_base=0.1,
+                             kill_grace=1.0, startup_grace=300.0,
+                             registry=MetricsRegistry())
+        results = sup.run(timeout=540.0)
+        for r in results:
+            assert r.returncode == 0, \
+                f"rank {r.rank} failed:\n{r.stderr[-3000:]}"
+        with open(out + ".rank0") as f:
+            return json.load(f), sup
+
+    clean, sup0 = run(None, "clean")
+    assert sup0.restarts == 0
+    faulted, sup1 = run("crash@iter=5,rank=1", "faulted")
+    assert sup1.restarts >= 1
+    assert faulted["incarnation"] >= 1
+    assert faulted["start"] == 4  # ckpt after step 3 survived; crash was at 5
+    # same batch stream: every step the restarted incarnation ran consumed
+    # byte-identical batches to the unfaulted gang's same step
+    assert faulted["step_hashes"]
+    for step, digest in faulted["step_hashes"].items():
+        assert clean["step_hashes"][step] == digest, f"step {step} diverged"
+    # exact param parity with the unfaulted run
+    np.testing.assert_array_equal(
+        np.asarray(faulted["param_tail"]), np.asarray(clean["param_tail"]))
+    assert faulted["param_sum"] == clean["param_sum"]

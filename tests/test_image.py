@@ -114,12 +114,14 @@ class TestImageRecordReader:
         assert it._pool is None
 
     def test_num_workers_defaults_to_cpu_count(self, image_dir):
-        import os
+        # ISSUE 6 satellite: the default is the AFFINITY count (what a
+        # cgroup/taskset-limited host can actually run), not os.cpu_count()
+        from deeplearning4j_tpu.common.environment import host_cpu_count
 
         rr = ImageRecordReader(8, 8, 3, ParentPathLabelGenerator())
         rr.initialize(FileSplit(str(image_dir)))
         it = ImageRecordReaderDataSetIterator(rr, 4)
-        assert it.num_workers == (os.cpu_count() or 1)
+        assert it.num_workers == host_cpu_count()
 
     def test_transform_chain_deterministic_per_seed(self, image_dir):
         chain = PipelineImageTransform([
