@@ -73,6 +73,18 @@ def calibration_probe():
             "probe_shape": "8x(2048^2 bf16 matmul)"}
 
 
+# ----------------------------------------------------------- step attribution
+
+
+def _phase_recorder():
+    """Per-step phase breakdown (ISSUE 7 layer 3) on the PROCESS registry, so
+    the `tdl_step_phase_seconds` histograms ride the telemetry block and the
+    per-variant percentage tables come from the same observations."""
+    from deeplearning4j_tpu.monitoring import StepPhaseRecorder
+
+    return StepPhaseRecorder()
+
+
 # --------------------------------------------------------------------- config
 
 
@@ -127,9 +139,12 @@ def bench_resnet50(p):
         params, opt, bn, loss = step(params, opt, bn, it, ep, x, y, None, rng)
     float(loss)  # device fetch = true sync (drains the axon tunnel queue)
 
+    phases = _phase_recorder()
     t0 = time.perf_counter()
     for _ in range(p["steps"]):
-        params, opt, bn, loss = step(params, opt, bn, it, ep, x, y, None, rng)
+        with phases.phase("compute"):
+            params, opt, bn, loss = step(params, opt, bn, it, ep, x, y, None, rng)
+        phases.step_done()
     float(loss)
     dt = time.perf_counter() - t0
     out = {"metric": "resnet50_train_images_per_sec",
@@ -242,22 +257,35 @@ def _resnet_pipeline_variant(p, step, params, opt, bn, rng, synthetic_ips, steps
             ImagePreProcessingScaler(), source_layout="NHWC"))
         done = 0
         t0 = None
+        phases = _phase_recorder()
         while data.has_next() and done <= steps:
-            ds = data.next()  # already device-resident uint8 NHWC
+            with phases.phase("input"):
+                ds = data.next()  # already device-resident uint8 NHWC
             if ds.features.shape[0] < batch:
                 break
-            params, opt, bn, loss = jstep(params, opt, bn, it_j, ep_j,
-                                          ds.features, ds.labels, rng)
+            with phases.phase("compute"):
+                params, opt, bn, loss = jstep(params, opt, bn, it_j, ep_j,
+                                              ds.features, ds.labels, rng)
             done += 1
-            if t0 is None:  # first batch is warmup (compile + queue fill)
+            if t0 is None:  # first batch is warmup (compile + queue fill):
+                # discard its phases entirely — observing the compile outlier
+                # would skew the exported tdl_step_phase_seconds histogram
+                phases.discard()
                 float(loss)
                 t0 = time.perf_counter()
+            else:
+                phases.step_done()
         float(loss)
         dt = time.perf_counter() - t0
         ips = batch * (done - 1) / dt
         pipe_stats = data.stats()
         data.reset()  # stop the worker + release the staged HBM batches
         jpeg = {"images_per_sec": round(ips, 2),
+                # ISSUE 7 layer 3: where does a step's wall actually go —
+                # input (blocked on the prefetcher), compute (step dispatch),
+                # h2d/collective (≈0 here: staging overlaps worker-side,
+                # single chip). Percentages of measured step wall, ~100 total
+                "phases": phases.summary(),
                 "vs_synthetic": round(ips / synthetic_ips, 3), "steps": done - 1,
                 # JPEG decode is host-CPU-bound (~3ms/core/image at 224²):
                 # the AFFINITY core count (not os.cpu_count — a cgroup-
@@ -314,18 +342,25 @@ def _resnet_pipeline_cached(p, jstep, params, opt, bn, rng, synthetic_ips,
     done = 0
     t0 = None
     loss = None
+    phases = _phase_recorder()
     while done <= steps:
         if not data.has_next():
             data.reset()
-        ds = data.next()
+        with phases.phase("input"):
+            ds = data.next()
         if ds.features.shape[0] < batch:
             continue
-        params, opt, bn, loss = jstep(params, opt, bn, it_j, ep_j,
-                                      ds.features, ds.labels, rng)
+        with phases.phase("compute"):
+            params, opt, bn, loss = jstep(params, opt, bn, it_j, ep_j,
+                                          ds.features, ds.labels, rng)
         done += 1
-        if t0 is None:  # first batch warms compile + queue
+        if t0 is None:  # first batch warms compile + queue: discard its
+            # phases (the compile outlier must not skew the histogram)
+            phases.discard()
             float(loss)
             t0 = time.perf_counter()
+        else:
+            phases.step_done()
     float(loss)
     dt = time.perf_counter() - t0
     ips = batch * (done - 1) / dt
@@ -358,6 +393,7 @@ def _resnet_pipeline_cached(p, jstep, params, opt, bn, rng, synthetic_ips,
 
     return ({"images_per_sec": round(ips, 2),
              "vs_synthetic": round(ips / synthetic_ips, 3),
+             "phases": phases.summary(),
              "steps": done - 1, "cache_build_s": round(build_s, 2),
              "host_etl_images_per_sec": round(host_ips, 1),
              "host_etl_vs_synthetic": round(host_ips / synthetic_ips, 3),
@@ -390,6 +426,8 @@ def _resnet_pipeline_etl(p, jstep, params, opt, bn, rng, synthetic_ips,
         img_dir, hw, hw, batch_size=batch, num_classes=classes,
         store_pad=32, cache_dir=os.path.join(img_dir, "_etlcache"))
 
+    from deeplearning4j_tpu.monitoring import get_registry
+
     def host_rate(workers, epochs=2):
         it = EtlDataSetIterator(spec, num_workers=workers,
                                 registry=MetricsRegistry())
@@ -411,28 +449,37 @@ def _resnet_pipeline_etl(p, jstep, params, opt, bn, rng, synthetic_ips,
              for w in sorted({1, 2, 4, host})]
 
     # full stack at the largest worker count: decode → ring → device_put →
-    # fused uint8 ingest train step
+    # fused uint8 ingest train step. PROCESS registry on purpose (unlike the
+    # per-variant fresh registries above): this variant is what makes the
+    # tdl_h2d_*/tdl_etl_*/prefetch families show up in the telemetry block,
+    # so --check-telemetry can prove they're alive end to end
     w_max = curve[-1]["workers"]
     data = DevicePrefetchIterator(
-        EtlDataSetIterator(spec, num_workers=w_max,
-                           registry=MetricsRegistry()),
-        buffer_size=3, registry=MetricsRegistry())
+        EtlDataSetIterator(spec, num_workers=w_max, registry=get_registry()),
+        buffer_size=3, registry=get_registry())
     it_j = jnp.asarray(0, jnp.int32)
     ep_j = jnp.asarray(0, jnp.int32)
     done = 0
     t0 = None
     loss = None
+    phases = _phase_recorder()
     try:
         while done <= steps:
             if not data.has_next():
                 data.reset()
-            ds = data.next()
-            params, opt, bn, loss = jstep(params, opt, bn, it_j, ep_j,
-                                          ds.features, ds.labels, rng)
+            with phases.phase("input"):
+                ds = data.next()
+            with phases.phase("compute"):
+                params, opt, bn, loss = jstep(params, opt, bn, it_j, ep_j,
+                                              ds.features, ds.labels, rng)
             done += 1
-            if t0 is None:  # first batch warms compile + ring fill
+            if t0 is None:  # first batch warms compile + ring fill: discard
+                # its phases (the compile outlier must not skew the histogram)
+                phases.discard()
                 float(loss)
                 t0 = time.perf_counter()
+            else:
+                phases.step_done()
         float(loss)
         dt = time.perf_counter() - t0
         pipe_stats = data.stats()  # includes the merged etl_* counters
@@ -442,6 +489,7 @@ def _resnet_pipeline_etl(p, jstep, params, opt, bn, rng, synthetic_ips,
     return {"workers_curve": curve, "workers": w_max,
             "images_per_sec": round(ips, 2),
             "vs_synthetic": round(ips / synthetic_ips, 3),
+            "phases": phases.summary(),
             "steps": done - 1, **pipe_stats}
 
 
@@ -759,6 +807,54 @@ BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "w2v": bench_w2v, "bert": bench_bert, "serving": bench_serving}
 
 
+# -------------------------------------------------------- telemetry checking
+
+
+def documented_bench_families(doc_path=None):
+    """Metric families docs/OBSERVABILITY.md marks as exercised by a full
+    bench run (a ``bench`` cell containing ``yes``). The doc's catalog table
+    is the single source of truth, so a family added to the code without a
+    catalog row — or documented but silently dead (the PR 1
+    ``last_batch_size`` bug class) — fails ``--check-telemetry``."""
+    import re
+
+    path = pathlib.Path(doc_path) if doc_path else (
+        _HERE / "docs" / "OBSERVABILITY.md")
+    families = []
+    for line in path.read_text().splitlines():
+        m = re.match(r"\|\s*`(tdl_[a-z0-9_]+)`\s*\|", line)
+        if not m:
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if cells and cells[-1].lower().startswith("yes"):
+            families.append(m.group(1))
+    if not families:
+        raise RuntimeError(f"no bench-marked metric families parsed from {path}")
+    return families
+
+
+def check_telemetry(out, families):
+    """Families documented as bench-exercised but absent (or observation-free)
+    in the telemetry block. Histograms with zero observations and counters
+    never incremented count as missing — a dead metric that still registers
+    itself is exactly the failure mode this catches."""
+    metrics = (out.get("telemetry") or {}).get("metrics") or {}
+    missing = []
+    for fam in families:
+        snap = metrics.get(fam)
+        series = (snap or {}).get("series") or []
+        if snap and snap.get("type") == "histogram":
+            # a registered-but-never-observed histogram is dead
+            alive = any(s.get("count", 0) > 0 for s in series)
+        else:
+            # counters/gauges create a series on first touch; a series whose
+            # value drained back to 0 (queue depth) is still alive
+            alive = bool(series)
+        if not alive:
+            missing.append(fam)
+    return missing
+
+
 def main():
     import jax
 
@@ -773,10 +869,15 @@ def main():
 
     backend = jax.default_backend()
     params = _scale(backend == "tpu")
-    only = sys.argv[1] if len(sys.argv) > 1 else None
+    args = [a for a in sys.argv[1:] if a != "--check-telemetry"]
+    check = "--check-telemetry" in sys.argv[1:]
+    only = args[0] if args else None
     if only and only not in BENCHES:
         sys.exit(f"unknown benchmark {only!r}; choose from: {', '.join(BENCHES)}")
     names = [only] if only else list(BENCHES)
+    if check and only:
+        sys.exit("--check-telemetry needs the full run (every documented "
+                 "family must get a chance to appear); drop the config name")
 
     results = {}
     for name in names:
@@ -811,8 +912,20 @@ def main():
         "telemetry": {"compiles": recompile_wd.stats(),
                       "metrics": get_registry().snapshot()},
     }
+    # step-time attribution headline (ISSUE 7): the ResNet-50 pipeline's
+    # phase-percentage table, mirrored into the telemetry block
+    pipeline = (results.get("resnet50") or {}).get("pipeline") or {}
+    if "phases" in pipeline:
+        out["telemetry"]["step_phases"] = pipeline["phases"]
     recompile_wd.close()
     print(json.dumps(out))
+    if check:
+        missing = check_telemetry(out, documented_bench_families())
+        if missing:
+            sys.exit("documented metric families missing/observation-free in "
+                     f"the telemetry block (silently dead?): {missing}")
+        print("check-telemetry: all documented bench families present",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -12,6 +12,8 @@ production preemption would.
     crash@iter=7,rank=1          hard os._exit at train iteration 7 on rank 1
     hang@iter=5,rank=0           wedge (sleep forever) at iteration 5, rank 0
     slow_ckpt_io=2.0             sleep 2.0s inside every checkpoint write
+    slow_ckpt_io@value=2.0,rank=1  same, rank 1 only (the straggler fault
+                                 the observability skew tests inject)
     slow_infer@p=0.05            sleep 0.05s inside every inference batch
     fail_infer@n=5               raise InjectedFault on every 5th inference
 
@@ -135,6 +137,21 @@ class FaultInjector:
             return False
         return f.fires_in_incarnation(self.incarnation)
 
+    def _flight_note(self, f: Fault, iteration: Optional[int]) -> None:
+        """Record the injected fault in the flight recorder and flush its
+        ring: crash is ``os._exit`` and hang never returns, so this is the
+        victim's LAST chance to get its final events (incl. the current
+        step_begin) onto disk for the postmortem."""
+        try:
+            from ..monitoring import flight
+
+            flight.record("fault_injected", fault=f.kind,
+                          iteration=iteration, rank=self.rank,
+                          incarnation=self.incarnation)
+            flight.flush()
+        except Exception:  # the black box must never mask the fault itself
+            log.exception("flight recorder flush failed during fault injection")
+
     def fire(self, site: str, iteration: Optional[int] = None) -> None:
         if site == "infer":
             self._infer_calls += 1
@@ -142,6 +159,7 @@ class FaultInjector:
             if site == "train_step" and f.kind in ("crash", "hang"):
                 if not self._matches(f, iteration):
                     continue
+                self._flight_note(f, iteration)
                 if f.kind == "crash":
                     log.warning("fault injection: crash at iteration %s rank %s "
                                 "(incarnation %s)", iteration, self.rank,
@@ -154,8 +172,11 @@ class FaultInjector:
                 while True:  # wedged worker: alive but makes no progress
                     time.sleep(1.0)
             elif site == "ckpt_write" and f.kind == "slow_ckpt_io":
-                # unlike crash/hang, slow IO fires in EVERY incarnation
-                # unless explicitly pinned with restart=N
+                # rank-filtered like the serving faults (a straggler fault
+                # targets ONE rank); unlike crash/hang, slow IO fires in
+                # EVERY incarnation unless explicitly pinned with restart=N
+                if f.rank is not None and f.rank != self.rank:
+                    continue
                 if ("restart" not in f.params
                         or f.fires_in_incarnation(self.incarnation)):
                     time.sleep(f.value)

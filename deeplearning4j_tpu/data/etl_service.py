@@ -324,7 +324,7 @@ class DecodedBatchCache:
                 # gang teardown) leaves the lock forever; reclaim it once
                 # stale so restarts never wedge on a poisoned cache dir
                 try:
-                    if time.time() - os.path.getmtime(lock) > self._STALE_LOCK_S:
+                    if time.time() - os.path.getmtime(lock) > self._STALE_LOCK_S:  # wallclock-ok: compared against a file mtime, which is wall clock
                         os.unlink(lock)
                 except FileNotFoundError as e:
                     log.debug("cache lock vanished while probing: %s", e)
@@ -573,6 +573,7 @@ class EtlDataSetIterator(DataSetIterator):
         self._epoch_start = 0   # position where the current epoch window began
         self._resume_pending = False
         self._last_occ = 0
+        self._occ_hwm = 0       # ring-occupancy high-watermark (flight event)
         self._started = False
         self._shm = None
         self._seq = self._feats = self._labs = None
@@ -844,6 +845,12 @@ class EtlDataSetIterator(DataSetIterator):
         occ = int(sum(1 for k in range(self.slots)
                       if self._seq[(self._next_j + k) % self.slots]
                       == self._next_j + k))
+        if occ > self._occ_hwm:
+            self._occ_hwm = occ
+            from ..monitoring import flight  # lazy: consumer-side only
+
+            flight.record("queue_hwm", queue="etl_ring", depth=occ,
+                          slots=self.slots)
         self._m.ring_occupancy.set(occ)
         self._m.batches.inc()
         hits = int(sum(self._counters[0::2]))
@@ -855,6 +862,9 @@ class EtlDataSetIterator(DataSetIterator):
         self._m.busy_frac.set(
             min(1.0, sum(self._busy) / (wall * self.num_workers)))
         self._last_occ = occ
+        from ..monitoring import aggregate  # lazy: consumer-side only
+
+        aggregate.maybe_spool()  # ETL pool's aggregated-/metrics spool
 
     def etl_stats(self) -> dict:
         """Ring/cache health for ``DevicePrefetchIterator.stats()`` and

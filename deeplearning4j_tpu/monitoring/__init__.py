@@ -8,16 +8,23 @@
 - :mod:`.watchdogs` — device-memory watermark sampler + XLA recompile /
   shape-churn detector;
 - :mod:`.listener` — ``MetricsListener``, the TrainingListener bridge that
-  wires a network's fit loop into the registry.
+  wires a network's fit loop into the registry;
+- :mod:`.aggregate` — per-process metrics spools merged into ONE
+  proc/rank-labeled ``/metrics`` with derived straggler gauges (ISSUE 7);
+- :mod:`.flight` — the flight recorder: a bounded ring of structured events
+  every process appends to, merged into ``postmortem.json`` on gang failure.
 """
 
+from .aggregate import MetricsSpooler, maybe_spool, merged_prometheus
 from .etl import etl_metrics
+from .flight import FlightRecorder, get_flight_recorder, set_flight_recorder
 from .heartbeat import HeartbeatWriter, maybe_beat, read_heartbeat
 from .listener import MetricsListener
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
 from .serving import serving_metrics
-from .trace import Span, current_span_path, set_trace_profiler, span, step_span
+from .trace import (Span, StepPhaseRecorder, current_span_path,
+                    set_trace_profiler, span, step_phase_histogram, step_span)
 from .watchdogs import (DeviceMemoryWatchdog, RecompileWatchdog, active,
                         host_rss_bytes, note_signature, note_step,
                         signature_of)
@@ -31,10 +38,18 @@ __all__ = [
     "etl_metrics",
     "serving_metrics",
     "MetricsListener",
+    "MetricsSpooler",
+    "maybe_spool",
+    "merged_prometheus",
+    "FlightRecorder",
+    "get_flight_recorder",
+    "set_flight_recorder",
     "HeartbeatWriter",
     "maybe_beat",
     "read_heartbeat",
     "Span",
+    "StepPhaseRecorder",
+    "step_phase_histogram",
     "span",
     "step_span",
     "current_span_path",

@@ -48,8 +48,11 @@ class HeartbeatWriter:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"iteration": int(iteration), "pid": os.getpid(),
-                       "time": time.time()}, f)
+                       "time": time.time()}, f)  # wallclock-ok: embedded event timestamp; liveness rides file mtime
         os.replace(tmp, self.path)
+        from . import flight  # lazy: flight imports nothing from here
+
+        flight.record("heartbeat", iteration=int(iteration), rank=self.rank)
         return True
 
 

@@ -44,6 +44,20 @@ def _escape_label_value(v: str) -> str:
     return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
 
 
+def _escape_help(v: str) -> str:
+    # HELP lines escape backslash and newline only (no quotes to close), per
+    # the text-format spec — an unescaped newline in help text splits the
+    # line and every strict scraper rejects the file
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _validate_label_name(name: str) -> str:
+    if (not name or not (name[0].isalpha() or name[0] == "_")
+            or not all(c.isalnum() or c == "_" for c in name)):
+        raise ValueError(f"invalid label name {name!r}")
+    return name
+
+
 def _fmt_value(v: float) -> str:
     if math.isinf(v):
         return "+Inf" if v > 0 else "-Inf"
@@ -69,7 +83,7 @@ class _Metric:
     def __init__(self, name: str, help: str = "", labels: Sequence[str] = ()):
         self.name = _validate_name(name)
         self.help = help
-        self.label_names = tuple(labels)
+        self.label_names = tuple(_validate_label_name(l) for l in labels)
         self._children: Dict[_LabelKey, object] = {}
         self._lock = threading.Lock()
 
@@ -104,12 +118,20 @@ class _Metric:
         with self._lock:
             return sorted(self._children.items())
 
+    def clear_children(self) -> None:
+        """Drop every labelset child. For info-style metrics that must show
+        only the LATEST labelset (e.g. the gang's last failure
+        classification) — without this, every historic labelset lingers as
+        its own series forever."""
+        with self._lock:
+            self._children.clear()
+
     # -- exposition -------------------------------------------------------
 
     def expose(self) -> List[str]:
         lines = []
         if self.help:
-            lines.append(f"# HELP {self.name} {self.help}")
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
         lines.append(f"# TYPE {self.name} {self.kind}")
         for key, child in self._iter_children():
             lines.extend(self._expose_child(key, child))

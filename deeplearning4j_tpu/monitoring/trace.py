@@ -104,3 +104,129 @@ def step_span(step_num: int, name: str = "train",
     device trace's step-time view and the host cadence agree on boundaries."""
     return Span(name, profiler=profiler, histogram=histogram,
                 step_num=step_num)
+
+
+# -- step-time attribution (ISSUE 7 tentpole, layer 3) -----------------------
+#
+# The signals were already captured but scattered: input wait in
+# DevicePrefetchIterator, h2d seconds worker-side, compute implicit in the
+# step histogram, collective bytes (not seconds) in the trainer. The
+# StepPhaseRecorder unifies them into ONE per-step breakdown: phases recorded
+# as (nesting-aware, exclusive-time) spans, exported simultaneously as
+# chrome-trace events (via the module trace profiler, when attached), as the
+# `tdl_step_phase_seconds{phase=...}` histogram family, and as the
+# phase-percentage table in bench.py's telemetry block.
+
+#: canonical phase names; recorders accept others but the bench table and
+#: OBSERVABILITY.md catalog enumerate these four
+STEP_PHASES = ("input", "h2d", "compute", "collective")
+
+
+def step_phase_histogram(registry=None):
+    """Get-or-create the `tdl_step_phase_seconds` family — one declaration
+    site so trainers, masters, bench.py and tests agree on name + labels."""
+    if registry is None:
+        from .registry import get_registry
+
+        registry = get_registry()
+    return registry.histogram(
+        "tdl_step_phase_seconds",
+        "Seconds of one train step attributed to a phase (exclusive time: "
+        "a phase nested inside another counts only toward itself)",
+        labels=("phase",))
+
+
+class _PhaseTimer:
+    """Context manager timing one phase occurrence. Host timing only unless
+    a trace profiler is attached — then a full :class:`Span` rides along so
+    the phase also lands on the chrome-trace/XProf timelines."""
+
+    __slots__ = ("_rec", "_name", "_span", "_t0", "_children")
+
+    def __init__(self, rec: "StepPhaseRecorder", name: str):
+        self._rec = rec
+        self._name = name
+        self._span = None
+
+    def __enter__(self):
+        if _trace_profiler is not None:
+            self._span = Span(self._name)
+            self._span.__enter__()
+        self._t0 = time.perf_counter()
+        self._children = 0.0
+        self._rec._frames.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        frames = self._rec._frames
+        frames.pop()
+        # exclusive time: my nested phases already claimed their share
+        self._rec.add(self._name, max(0.0, dur - self._children))
+        if frames:
+            frames[-1]._children += dur
+        if self._span is not None:
+            self._span.__exit__(*exc)
+        return False
+
+
+class StepPhaseRecorder:
+    """Accumulates per-phase seconds across one step, observes them into the
+    histogram family at :meth:`step_done`, and keeps running totals for the
+    bench phase-percentage table. One instance per fit loop thread."""
+
+    def __init__(self, registry=None):
+        self._hist = step_phase_histogram(registry)
+        self._acc: dict = {}
+        self._totals: dict = {}
+        self._frames: list = []
+        self._steps = 0
+        self._wall = 0.0
+        self._last_done: Optional[float] = None
+
+    def phase(self, name: str) -> _PhaseTimer:
+        """``with recorder.phase("input"): ds = next(it)``"""
+        return _PhaseTimer(self, name)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Attribute already-measured seconds (e.g. an h2d counter delta)."""
+        self._acc[name] = self._acc.get(name, 0.0) + float(seconds)
+
+    def discard(self) -> None:
+        """Drop phase time accumulated since the last :meth:`step_done`.
+        For loop boundaries: the ``next()`` that raises StopIteration still
+        records an "input" slice, which belongs to no step — without the
+        discard it would pollute the NEXT epoch's (or fit call's) first
+        step."""
+        self._acc = {}
+
+    def step_done(self) -> None:
+        for name, s in self._acc.items():
+            self._hist.labels(name).observe(s)
+            self._totals[name] = self._totals.get(name, 0.0) + s
+        now = time.perf_counter()
+        if self._last_done is not None:
+            self._wall += now - self._last_done
+        else:
+            # first step has no prior boundary: its wall is what we measured
+            self._wall += sum(self._acc.values())
+        self._last_done = now
+        self._steps += 1
+        self._acc = {}
+
+    def summary(self) -> dict:
+        """Phase-percentage table over the recorded steps' total wall.
+        The canonical phases always appear (0.0 when never recorded) so the
+        input/h2d/compute/collective breakdown reads complete; `other_pct`
+        is the unattributed remainder — near zero when the loop is fully
+        instrumented, which is what "sums to ~100%" means."""
+        wall = max(self._wall, sum(self._totals.values()), 1e-9)
+        phases = {}
+        for name in list(STEP_PHASES) + sorted(set(self._totals) - set(STEP_PHASES)):
+            s = self._totals.get(name, 0.0)
+            phases[name] = {"seconds": round(s, 4),
+                            "pct": round(100.0 * s / wall, 2)}
+        attributed = sum(p["pct"] for p in phases.values())
+        return {"steps": self._steps, "wall_seconds": round(wall, 4),
+                "phases": phases,
+                "other_pct": round(max(0.0, 100.0 - attributed), 2)}

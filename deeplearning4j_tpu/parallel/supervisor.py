@@ -34,6 +34,7 @@ mid-collective partial state is unrecoverable by construction.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import random
@@ -43,6 +44,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..monitoring import aggregate, flight
+from ..monitoring.flight import FlightRecorder
 from ..monitoring.heartbeat import ENV_DIR, ENV_INTERVAL, read_heartbeat
 from ..monitoring.registry import MetricsRegistry, get_registry
 from . import launcher
@@ -84,6 +87,14 @@ def _supervisor_metrics(registry: MetricsRegistry):
                          "Whole-gang restarts performed by GangSupervisor"),
         registry.histogram("tdl_gang_recovery_seconds",
                            "Failure detection to gang respawned"),
+        # info-style gauge: ONE series whose labels say WHY the gang last
+        # restarted (value = budgeted restarts performed when it happened).
+        # tdl_gang_restarts_total says how often; this says why — served
+        # through /metrics.json so a dashboard needs no label parsing.
+        registry.gauge("tdl_gang_last_failure_info",
+                       "Last gang failure (labels carry the classification; "
+                       "value = restarts performed at that point)",
+                       labels=("reason", "rank", "iteration")),
     )
 
 
@@ -130,6 +141,7 @@ class GangSupervisor:
         import tempfile
 
         self.workdir = workdir or tempfile.mkdtemp(prefix="tdl_gang_")
+        os.makedirs(self.workdir, exist_ok=True)  # postmortem.json lands here
         self.max_restarts = max_restarts
         self.hang_timeout = hang_timeout
         self.startup_grace = startup_grace
@@ -148,8 +160,16 @@ class GangSupervisor:
         self.kill_grace = kill_grace
         self.same_iteration_fatal = max(2, same_iteration_fatal)
         self.registry = registry or get_registry()
-        self._deaths, self._restarts_ctr, self._recovery_hist = (
-            _supervisor_metrics(self.registry))
+        (self._deaths, self._restarts_ctr, self._recovery_hist,
+         self._last_failure_info) = _supervisor_metrics(self.registry)
+        # the supervisor's own black box (restart decisions, classifications);
+        # ring-only — its events merge into postmortem.json from memory
+        self._flight = FlightRecorder(proc="supervisor")
+        self.last_failure: Optional[Dict] = None
+        #: merged flight-recorder timeline of the most recent failure
+        self.postmortem_path = os.path.join(self.workdir, "postmortem.json")
+        #: one stable spool dir for ALL attempts — attachable once
+        self.spool_dir = os.path.join(self.workdir, "spool")
 
         self.events: List[GangEvent] = []
         self.restarts = 0           # budgeted restarts performed
@@ -178,27 +198,44 @@ class GangSupervisor:
                 return self._collect(procs)
             self.events.append(failure)
             self._deaths.labels(failure.reason).inc(len(failure.ranks))
+            self._note_failure(failure)
             self._kill_gang(procs)
+            # gang is down: collect every rank's flight ring into ONE
+            # monotonic-ordered postmortem BEFORE deciding what happens next
+            self._write_postmortem(failure)
             if failure.reason == "timeout":
                 raise GangFailedError("supervision deadline exceeded",
                                       "timeout", self.events)
-            self._classify_or_raise(failure)
-            if failure.reason == "bind":
-                self.port_failures += 1
-                if self.port_failures > self.port_retries:
-                    raise GangFailedError(
-                        f"coordinator bind failed {self.port_failures} times",
-                        "bind", self.events)
-            else:
-                if self.restarts >= self.max_restarts:
-                    raise GangFailedError(
-                        f"gang failed ({failure.reason} at iteration "
-                        f"{failure.iteration}, ranks {failure.ranks}) and the "
-                        f"restart budget ({self.max_restarts}) is exhausted",
-                        self._final_classification(failure), self.events)
-                self.restarts += 1
-                self._restarts_ctr.inc()
-                self._backoff(self.restarts)
+            try:
+                self._classify_or_raise(failure)
+                if failure.reason == "bind":
+                    self.port_failures += 1
+                    if self.port_failures > self.port_retries:
+                        raise GangFailedError(
+                            f"coordinator bind failed {self.port_failures} times",
+                            "bind", self.events)
+                else:
+                    if self.restarts >= self.max_restarts:
+                        raise GangFailedError(
+                            f"gang failed ({failure.reason} at iteration "
+                            f"{failure.iteration}, ranks {failure.ranks}) and the "
+                            f"restart budget ({self.max_restarts}) is exhausted",
+                            self._final_classification(failure), self.events)
+                    self.restarts += 1
+                    self._restarts_ctr.inc()
+                    self._flight.record(
+                        "restart_decision", decision="restart",
+                        reason=failure.reason, ranks=list(failure.ranks),
+                        iteration=failure.iteration, restart=self.restarts)
+                    self._backoff(self.restarts)
+            except GangFailedError as e:
+                self._flight.record(
+                    "restart_decision", decision="fatal",
+                    classification=e.classification, reason=failure.reason,
+                    ranks=list(failure.ranks), iteration=failure.iteration,
+                    restart=self.restarts)
+                self._write_postmortem(failure, classification=e.classification)
+                raise
             attempt += 1
             if time.monotonic() >= deadline:
                 raise GangFailedError("supervision deadline exceeded",
@@ -222,6 +259,22 @@ class GangSupervisor:
         env[ENV_INCARNATION] = str(self.restarts)
         env[ENV_DIR] = hb_dir
         env[ENV_INTERVAL] = str(self.heartbeat_interval)
+        # observability plane (ISSUE 7): every supervised gang flight-records
+        # and spools metrics — postmortems and the aggregated /metrics need
+        # no opt-in. Flight dirs are per-ATTEMPT (a postmortem must hold the
+        # failing incarnation's events, not a respawn's overwrite); the
+        # metrics spool dir is STABLE across attempts so a dashboard attached
+        # once (UIServer.attach_spool_dir(sup.spool_dir)) keeps seeing live
+        # counters after restarts — read_spools dedupes respawned
+        # incarnations by newest spool per proc. setdefault: callers may
+        # re-point either dir through extra_env.
+        self.flight_dir = os.path.join(self.workdir, f"flight_{attempt}")
+        env.setdefault(flight.ENV_DIR, self.flight_dir)
+        env.setdefault(flight.ENV_INTERVAL, str(self.heartbeat_interval))
+        env.setdefault(aggregate.ENV_DIR, self.spool_dir)
+        env.setdefault(aggregate.ENV_INTERVAL, str(self.heartbeat_interval))
+        self.flight_dir = env[flight.ENV_DIR]
+        self.spool_dir = env[aggregate.ENV_DIR]
         procs = launcher.spawn(
             self.target, self.n_processes, self.n_local_devices,
             self.platform, extra_env=env, args=self.args, cwd=self.cwd,
@@ -339,6 +392,58 @@ class GangSupervisor:
                         err = text
             results.append(WorkerResult(rank, p.returncode, out, err))
         return results
+
+    # ------------------------------------------------------------ postmortem
+
+    def _note_failure(self, failure: GangEvent) -> None:
+        """Expose the last failure classification through the registry (ISSUE
+        7 satellite): a dashboard reading ``/metrics.json`` sees WHY the gang
+        last restarted, not just that ``tdl_gang_restarts_total`` moved."""
+        self.last_failure = {
+            "reason": failure.reason,
+            "ranks": list(failure.ranks),
+            "iteration": failure.iteration,
+            "restarts": self.restarts,
+        }
+        self._flight.record("gang_failure", reason=failure.reason,
+                            ranks=list(failure.ranks),
+                            iteration=failure.iteration,
+                            attempt=failure.attempt, detail=failure.detail)
+        self._last_failure_info.clear_children()  # one series: the LATEST
+        self._last_failure_info.labels(
+            failure.reason,
+            str(failure.ranks[0]) if failure.ranks else "",
+            str(failure.iteration) if failure.iteration is not None else "",
+        ).set(self.restarts)
+
+    def _write_postmortem(self, failure: GangEvent,
+                          classification: Optional[str] = None) -> str:
+        """Merge every rank's flight-recorder spool (plus the supervisor's
+        own ring) into ONE monotonic-clock-ordered ``postmortem.json`` so an
+        unattended failure is debuggable after the fact. Overwritten on each
+        failure — the file always describes the most recent one."""
+        flight_dir = getattr(self, "flight_dir", None)
+        spools = flight.read_spools(flight_dir) if flight_dir else []
+        events = flight.merge_events(spools, self._flight.events())
+        doc = {
+            "classification": classification or failure.reason,
+            "reason": failure.reason,
+            "ranks": list(failure.ranks),
+            "iteration": failure.iteration,
+            "attempt": failure.attempt,
+            "restarts_performed": self.restarts,
+            "detail": failure.detail,
+            "written_wall": time.time(),  # wallclock-ok: report timestamp for humans
+            "procs": sorted({e.get("proc", "?") for e in events}),
+            "events": events,
+        }
+        tmp = self.postmortem_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, self.postmortem_path)
+        log.warning("postmortem written to %s (%d events from %d procs)",
+                    self.postmortem_path, len(events), len(doc["procs"]))
+        return self.postmortem_path
 
     # -------------------------------------------------------- classification
 

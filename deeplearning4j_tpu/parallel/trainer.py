@@ -29,8 +29,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common import faults
 from ..data.dataset import DataSet
-from ..monitoring import heartbeat
+from ..monitoring import aggregate, flight, heartbeat
 from ..monitoring.registry import get_registry
+from ..monitoring.trace import StepPhaseRecorder
 from .mesh import AXIS_DATA, build_mesh
 
 
@@ -47,6 +48,12 @@ def _trainer_metrics():
                   labels=("trainer", "kind")),
         r.gauge("tdl_parallel_devices", "Devices participating in the mesh",
                 labels=("trainer",)),
+        r.histogram("tdl_step_wall_seconds",
+                    "Iteration-to-iteration wall time, including everything "
+                    "between steps (checkpoint IO, input stalls, barriers) — "
+                    "the per-rank signal the aggregated /metrics derives "
+                    "straggler skew from",
+                    labels=("trainer",)),
     )
 
 
@@ -71,10 +78,16 @@ class ParallelTrainer:
         self.sharding_rules = sharding_rules
         self._ndata = int(np.prod([self.mesh.shape[a] for a in (data_axis,) if a in self.mesh.shape]))
         self._placed = False
-        self._step_hist, self._coll_bytes, devices_gauge = _trainer_metrics()
+        (self._step_hist, self._coll_bytes, devices_gauge,
+         self._step_wall) = _trainer_metrics()
         self._trainer_label = type(self).__name__
         devices_gauge.labels(self._trainer_label).set(self.mesh.devices.size)
         self._grad_bytes: Optional[int] = None
+        # ISSUE 7 layer 3: per-step phase attribution (input/h2d/compute/
+        # collective) through monitoring.trace — one recorder per trainer,
+        # families land in the process registry
+        self._phases = StepPhaseRecorder()
+        self._last_step_entry: Optional[float] = None
 
     # -- placement ----------------------------------------------------------
 
@@ -84,8 +97,9 @@ class ParallelTrainer:
     def _shard(self, x):
         if x is None:
             return None
-        spec = P(self.data_axis, *([None] * (np.ndim(x) - 1)))
-        return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, spec))
+        with self._phases.phase("h2d"):
+            spec = P(self.data_axis, *([None] * (np.ndim(x) - 1)))
+            return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, spec))
 
     def _place_net(self):
         if self._placed:
@@ -186,8 +200,20 @@ class ParallelTrainer:
             iterator = self.prefetch(iterator, buffer_size=prefetch)
         try:
             for _ in range(epochs):
-                for ds in iterator:
+                batches = iter(iterator)
+                while True:
+                    # pulling the next batch is the step's "input" phase —
+                    # ≈0 when prefetch keeps the chip fed, the whole stall
+                    # when ETL/decode is the wall
+                    with self._phases.phase("input"):
+                        try:
+                            ds = next(batches)
+                        except StopIteration:
+                            break
                     self._fit_batch(ds)
+                # the exhausting next() recorded an input slice belonging to
+                # no step — don't smear it into the next epoch's first step
+                self._phases.discard()
                 self.net.epoch += 1
         finally:
             # join async prefetch workers even when a step raises — a
@@ -197,6 +223,9 @@ class ParallelTrainer:
 
             if isinstance(iterator, AsyncDataSetIterator):
                 iterator.close()
+            # last spool carries the final counters (no-op unsupervised)
+            aggregate.maybe_spool(force=True)
+            flight.flush()
         return self.net
 
     def _fit_batch(self, ds: DataSet):
@@ -214,12 +243,25 @@ class ParallelTrainer:
     def _fit_core(self, ds: DataSet):
         # gang-supervision hooks (no-ops unless the TDL_HEARTBEAT_DIR /
         # TDL_FAULT_SPEC env contracts are active): heartbeat FIRST so a
-        # crash/hang injected at iteration k is attributed to k
+        # crash/hang injected at iteration k is attributed to k, then the
+        # flight step_begin so a victim's final step is on the black box
+        # BEFORE the fault fires (the injector flushes the ring)
         it = int(self.net.iteration)
         heartbeat.maybe_beat(it)
+        flight_on = flight.active()
+        if flight_on:
+            flight.record("step_begin", iteration=it)
         faults.fault_point("train_step", iteration=it)
+        now = time.perf_counter()
+        if self._last_step_entry is not None:
+            # iteration-to-iteration wall: includes checkpoint IO / barriers
+            # between fit calls — what a straggling rank actually loses
+            self._step_wall.labels(self._trainer_label).observe(
+                now - self._last_step_entry)
+        self._last_step_entry = now
         t0 = time.perf_counter()
-        self._fit_core_inner(ds)
+        with self._phases.phase("compute"):
+            self._fit_core_inner(ds)
         self._step_hist.labels(self._trainer_label).observe(time.perf_counter() - t0)
         if self._ndata > 1:
             # logical payload of the per-step gradient allreduce GSPMD
@@ -230,6 +272,17 @@ class ParallelTrainer:
                     for l in jax.tree.leaves(self.net.params_))
             self._coll_bytes.labels(self._trainer_label,
                                     "grad_allreduce").inc(self._grad_bytes)
+        self._phases.step_done()
+        if flight_on:
+            loss = None
+            if (it + 1) % flight.loss_every() == 0:
+                try:  # reading the loss forces a device sync — see loss_every
+                    s = getattr(self.net, "score_", None)
+                    loss = float(s) if s is not None else None
+                except Exception:
+                    loss = None
+            flight.record("step_end", iteration=it, loss=loss)
+        aggregate.maybe_spool()
 
     def _fit_core_inner(self, ds: DataSet):
         n = self.net
@@ -268,8 +321,9 @@ class ParallelTrainer:
         """Placement hook: shard an already-jnp minibatch array on the mesh."""
         from jax.sharding import NamedSharding, PartitionSpec
 
-        spec = PartitionSpec(self.data_axis, *([None] * (x.ndim - 1)))
-        return jax.device_put(x, NamedSharding(self.mesh, spec))
+        with self._phases.phase("h2d"):
+            spec = PartitionSpec(self.data_axis, *([None] * (x.ndim - 1)))
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
 
 
 class MultiProcessTrainer(ParallelTrainer):
@@ -342,9 +396,11 @@ class MultiProcessTrainer(ParallelTrainer):
     def _shard(self, x):
         if x is None:
             return None
-        x = np.asarray(x)  # host-ok: make_array_from_process_local_data requires host buffers
-        spec = P(self.data_axis, *([None] * (x.ndim - 1)))
-        return jax.make_array_from_process_local_data(NamedSharding(self.mesh, spec), x)
+        with self._phases.phase("h2d"):
+            x = np.asarray(x)  # host-ok: make_array_from_process_local_data requires host buffers
+            spec = P(self.data_axis, *([None] * (x.ndim - 1)))
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, spec), x)
 
     def _shard_placed(self, x):
         return self._shard(x)
@@ -410,6 +466,9 @@ class ParameterAveragingTrainingMaster:
         return net
 
     def _average(self, replicas):
+        from ..monitoring.trace import step_phase_histogram
+
+        t0 = time.perf_counter()
         if self._params_bytes is None:  # param sizes are fixed after init
             self._params_bytes = sum(getattr(l, "nbytes", 0)
                                      for l in jax.tree.leaves(replicas[0].params_))
@@ -427,6 +486,9 @@ class ParameterAveragingTrainingMaster:
             for r in replicas:
                 r.updater_state = jax.tree.map(
                     lambda x: jnp.copy(x) if hasattr(x, "dtype") else x, mean_upd)
+        # the averaging pass IS this master's collective phase
+        step_phase_histogram().labels("collective").observe(
+            time.perf_counter() - t0)
 
 
 class SharedTrainingMaster(ParallelTrainer):

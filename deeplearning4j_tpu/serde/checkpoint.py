@@ -27,11 +27,13 @@ import logging
 import os
 import signal
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 from ..common import faults
+from ..monitoring import flight
 from ..monitoring.registry import get_registry
 
 log = logging.getLogger(__name__)
@@ -118,6 +120,10 @@ class TrainingCheckpointer:
         self._failures = get_registry().counter(
             "tdl_checkpoint_failures_total",
             "Checkpoint writes that raised (sync or async)")
+        self._save_hist = get_registry().histogram(
+            "tdl_ckpt_save_seconds",
+            "Wall time of one checkpoint shard write (disk side; async "
+            "writes observed on the background thread)")
         os.makedirs(directory, exist_ok=True)
 
     # ------------------------------------------------------------------ save
@@ -143,6 +149,7 @@ class TrainingCheckpointer:
             meta["iterator"] = iterator.state()
 
         def write():
+            t0 = time.perf_counter()
             faults.fault_point("ckpt_write")  # chaos: slow_ckpt_io=<seconds>
             # the save id (the iteration — identical on every process of a
             # synchronous SPMD run) is stamped into every shard AND the meta
@@ -165,6 +172,10 @@ class TrainingCheckpointer:
                 with open(tmp_m, "w") as f:
                     json.dump(meta, f)
                 os.replace(tmp_m, os.path.join(ckdir, _STATE_FILE))
+            dt = time.perf_counter() - t0
+            self._save_hist.observe(dt)
+            flight.record("ckpt_save", tag=tag,
+                          iteration=meta["iteration"], seconds=round(dt, 4))
 
         def async_guarded_write():
             try:
@@ -253,6 +264,8 @@ class TrainingCheckpointer:
         net.epoch = meta["epoch"]
         if iterator is not None and "iterator" in meta and hasattr(iterator, "set_state"):
             iterator.set_state(meta["iterator"])
+        flight.record("ckpt_restore", tag=tag, iteration=meta["iteration"],
+                      epoch=meta["epoch"])
         return True
 
 

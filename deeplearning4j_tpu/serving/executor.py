@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common.faults import fault_point
+from ..monitoring import aggregate, flight
 from ..monitoring.serving import serving_metrics
 
 log = logging.getLogger(__name__)
@@ -145,6 +146,7 @@ class BatchingInferenceExecutor:
         self._accepting = False
         self._stopping = False
         self._warm = threading.Event()
+        self._depth_hwm = 0  # flight-recorded queue-depth high-watermark
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -219,8 +221,18 @@ class BatchingInferenceExecutor:
                 raise QueueFullError(
                     f"admission queue full ({self.max_queue} queued)")
             self._q.append(fut)
-            self._m.queue_depth.set(len(self._q))
+            depth = len(self._q)
+            self._m.queue_depth.set(depth)
+            new_hwm = depth > self._depth_hwm
+            if new_hwm:
+                self._depth_hwm = depth
             self._cv.notify()
+        if new_hwm:
+            # black-box breadcrumb: rising watermarks are the overload
+            # precursor a postmortem wants on the timeline (rare by
+            # construction — fires only on a NEW maximum)
+            flight.record("queue_hwm", queue="inference", depth=depth,
+                          max_queue=self.max_queue)
         return fut
 
     # -- inference thread --------------------------------------------------
@@ -247,6 +259,7 @@ class BatchingInferenceExecutor:
                     batch.append(req)
                 self._m.queue_depth.set(len(self._q))
             self._serve_batch(batch)
+            aggregate.maybe_spool()  # serving replica's aggregated-/metrics spool
 
     def _serve_batch(self, batch: List[InferenceFuture]) -> None:
         now = time.monotonic()

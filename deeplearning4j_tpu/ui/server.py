@@ -140,6 +140,8 @@ tick();setInterval(tick,2000);
 class _Handler(BaseHTTPRequestHandler):
     storage: StatsStorage = None  # injected
     registry = None  # MetricsRegistry; None = the process default
+    spool_dir = None  # metrics-spool dir → /metrics merges at scrape time
+    spool_local_proc = "local"  # proc label for THIS process's registry
 
     def log_message(self, *args):
         pass
@@ -176,12 +178,28 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/metrics":
             # Prometheus text exposition over the monitoring registry: the
-            # machine-readable twin of the overview page (scrape target)
-            self._text(self._registry().to_prometheus(),
-                       "text/plain; version=0.0.4; charset=utf-8")
+            # machine-readable twin of the overview page (scrape target).
+            # With a spool dir attached (ISSUE 7), every participating
+            # process's spooled registry merges into THIS one exposition at
+            # scrape time, proc/rank-labeled, with derived straggler gauges.
+            if self.spool_dir:
+                from ..monitoring import aggregate
+
+                body = aggregate.merged_prometheus(
+                    self.spool_dir, local_registry=self._registry(),
+                    local_proc=self.spool_local_proc)
+            else:
+                body = self._registry().to_prometheus()
+            self._text(body, "text/plain; version=0.0.4; charset=utf-8")
             return
         if self.path == "/metrics.json":
-            self._json(self._registry().snapshot())
+            if self.spool_dir:
+                from ..monitoring import aggregate
+
+                self._json(aggregate.merged_snapshot(
+                    self.spool_dir, local_registry=self._registry()))
+            else:
+                self._json(self._registry().snapshot())
             return
         if self.path == "/sessions":
             self._json(self.storage.session_ids())
@@ -417,6 +435,19 @@ class UIServer:
         self._httpd.RequestHandlerClass.registry = registry
 
     attachRegistry = attach_registry
+
+    def attach_spool_dir(self, directory: str, local_proc: str = "local") -> None:
+        """Serve the CLUSTER-wide ``/metrics`` (ISSUE 7): merge every
+        process's metrics spool in ``directory`` (e.g. a ``GangSupervisor``'s
+        ``spool_dir``) with this process's registry at scrape time — one
+        exposition, ``proc``/``rank`` labels on every series, derived
+        straggler gauges appended."""
+        if self._httpd is None:
+            self._start(self._storages[0] if self._storages else StatsStorage())
+        self._httpd.RequestHandlerClass.spool_dir = directory
+        self._httpd.RequestHandlerClass.spool_local_proc = local_proc
+
+    attachSpoolDir = attach_spool_dir
 
     def attach_model(self, net) -> None:
         """Populate the model tab (C14 model-graph tier): /train/model and

@@ -95,7 +95,9 @@ class StatsListener:
         self.collect_histograms = collect_histograms
         self.histogram_bins = histogram_bins
         self._last_params: Optional[Dict] = None
-        self._start = time.time()
+        # perf_counter: record["time"] is an elapsed-seconds duration; wall
+        # clock here steps backwards under NTP (ISSUE 7 satellite)
+        self._start = time.perf_counter()
 
     def iteration_done(self, model, iteration: int, epoch: int) -> None:
         if iteration % self.frequency:
@@ -104,7 +106,7 @@ class StatsListener:
             "session": self.session_id,
             "iteration": iteration,
             "epoch": epoch,
-            "time": time.time() - self._start,
+            "time": time.perf_counter() - self._start,
             "score": float(model.score_),
         }
         lr = getattr(model.conf.updater, "learning_rate", None)
@@ -250,9 +252,11 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         False if ``timeout`` elapsed first."""
         import time as _time
 
-        deadline = None if timeout is None else _time.time() + timeout
+        # monotonic: an NTP step during the wait must not stretch/cut the
+        # timeout (ISSUE 7 satellite — wall clock only for event timestamps)
+        deadline = None if timeout is None else _time.monotonic() + timeout
         while self._queue.unfinished_tasks:
-            if deadline is not None and _time.time() > deadline:
+            if deadline is not None and _time.monotonic() > deadline:
                 return False
             _time.sleep(0.005)
         return True

@@ -85,3 +85,52 @@ def _no_leaked_children_or_shm():
         f"test leaked live child processes {leaked_procs} and/or "
         f"shared-memory segments {sorted(leaked_shm)} — close() the ETL "
         "service / iterator (fit loops do it in their finally)")
+
+
+# -- observability-artifact leak audit (ISSUE 7 satellite) --------------------
+
+# Filenames/dirnames the observability plane writes. A test that points
+# TDL_METRICS_SPOOL_DIR / TDL_FLIGHT_DIR (or a GangSupervisor workdir) at
+# cwd or the shared tempdir instead of tmp_path leaves these behind for
+# every later test (and CI run) to trip over.
+_OBS_ARTIFACT_PREFIXES = ("tdl_metrics_", "tdl_flight_", "tdl_gang_")
+_OBS_ARTIFACT_NAMES = ("postmortem.json",)
+
+
+def _obs_artifacts():
+    import tempfile
+
+    found = set()
+    for base in (os.getcwd(), tempfile.gettempdir()):
+        try:
+            names = os.listdir(base)
+        except OSError:
+            continue
+        for n in names:
+            if n.startswith(_OBS_ARTIFACT_PREFIXES) or n in _OBS_ARTIFACT_NAMES:
+                found.add(os.path.join(base, n))
+    return found
+
+
+@pytest.fixture(autouse=True)
+def _no_spool_or_postmortem_outside_tmp_path():
+    """Fail any test that leaves metrics-spool / flight-recorder / postmortem
+    files (or a default-workdir gang dir) outside its tmp_path. Leaks are
+    cleaned after the failure is recorded so one offender can't cascade."""
+    import shutil
+
+    before = _obs_artifacts()
+    yield
+    leaked = _obs_artifacts() - before
+    for path in leaked:  # clean so later tests start from a known state
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.unlink(path)
+        except OSError:
+            pass
+    assert not leaked, (
+        f"test leaked observability artifacts outside tmp_path: "
+        f"{sorted(leaked)} — point TDL_METRICS_SPOOL_DIR/TDL_FLIGHT_DIR and "
+        "GangSupervisor(workdir=...) at tmp_path")

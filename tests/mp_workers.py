@@ -224,6 +224,45 @@ def supervised_train():
                   "global_devices": jax.device_count()})
 
 
+def observability_train():
+    """ISSUE 7 acceptance target: a 2-rank gang whose members train
+    INDEPENDENTLY (single-rank local mesh, no cross-rank collectives) with a
+    per-rank checkpoint every step — so a ``slow_ckpt_io@value=...,rank=1``
+    fault makes rank 1 a genuine straggler instead of being hidden by
+    lockstep barriers. Each rank's ``ParallelTrainer._fit_core`` drives the
+    whole observability plane via the env contracts the supervisor sets:
+    heartbeats, flight step events, ``tdl_step_wall_seconds`` (which
+    INCLUDES the checkpoint time between fit calls — the skew signal), and
+    the metrics spool the parent scrapes as one aggregated /metrics."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.monitoring import aggregate, flight
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+    from deeplearning4j_tpu.serde.checkpoint import TrainingCheckpointer
+
+    col = ProcessCollectives()
+    rank = col.rank
+    total_steps = int(os.environ.get("TDL_MP_STEPS", "8"))
+
+    net = _toy_net(seed=7 + rank)
+    mesh = Mesh(np.array(jax.local_devices()[:1]).reshape(1), ("data",))
+    trainer = ParallelTrainer(net, mesh)
+    ck = TrainingCheckpointer(os.path.join(os.environ["TDL_MP_CKPT"],
+                                           f"rank{rank}"), async_write=False)
+    for step in range(total_steps):
+        x, y = _global_batch(step)
+        trainer.fit([DataSet(x, y)])
+        ck.save(net)  # every step: the slow_ckpt_io rank straggles HERE
+    aggregate.maybe_spool(force=True)  # final counters for the parent's scrape
+    flight.flush()
+    col.barrier("obs-done")  # neither rank exits before both spooled
+    _write(rank, {"iterations": int(net.iteration), "rank": rank})
+
+
 def etl_train():
     """ISSUE 6 acceptance target: per-rank SHARDED multi-process ETL feeding
     a 2-rank data-parallel gang under GangSupervisor. Each rank's ETL
