@@ -57,6 +57,14 @@ class TransformerConfig:
         return TransformerConfig(**kw)
 
     @staticmethod
+    def bert_large(**kw) -> "TransformerConfig":
+        kw.setdefault("d_model", 1024)
+        kw.setdefault("n_heads", 16)
+        kw.setdefault("n_layers", 24)
+        kw.setdefault("d_ff", 4096)
+        return TransformerConfig(**kw)
+
+    @staticmethod
     def tiny(**kw) -> "TransformerConfig":
         kw.setdefault("vocab_size", 1024)
         kw.setdefault("max_len", 128)

@@ -20,6 +20,7 @@ from .etl import etl_metrics
 from .flight import FlightRecorder, get_flight_recorder, set_flight_recorder
 from .heartbeat import HeartbeatWriter, maybe_beat, read_heartbeat
 from .listener import MetricsListener
+from .partition import partition_metrics
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
 from .serving import serving_metrics
@@ -36,6 +37,7 @@ __all__ = [
     "MetricsRegistry",
     "get_registry",
     "etl_metrics",
+    "partition_metrics",
     "serving_metrics",
     "MetricsListener",
     "MetricsSpooler",

@@ -1,0 +1,39 @@
+"""Sharded-parameter training metric families (ISSUE 9).
+
+One declaration site so ``parallel.partition.Partitioner``, ``bench.py`` and
+the tests agree on names and labels. Families live in the process-wide
+registry, so every gang rank's values ride the PR 7 metrics spool
+(``TDL_METRICS_SPOOL_DIR``) and surface in the aggregated ``/metrics`` with
+``proc``/``rank`` labels — per-rank shard sizes are a first-class scrape.
+
+Families::
+
+    tdl_param_bytes_per_rank{kind}      bytes this rank actually holds for
+                                        kind="params" / kind="opt_state"
+                                        (sum of addressable shards — shrinks
+                                        ~linearly with the fsdp axis)
+    tdl_mesh_layout_info{data,fsdp,tp}  one series describing the active mesh
+                                        layout; value = devices in the mesh
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Optional
+
+from .registry import MetricsRegistry, get_registry
+
+
+def partition_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespace:
+    """Get-or-create the partition metric families on ``registry``."""
+    r = registry if registry is not None else get_registry()
+    return SimpleNamespace(
+        param_bytes=r.gauge(
+            "tdl_param_bytes_per_rank",
+            "bytes of model state this rank holds (addressable shards)",
+            labels=("kind",)),
+        layout_info=r.gauge(
+            "tdl_mesh_layout_info",
+            "active data/fsdp/tp mesh layout; value = mesh device count",
+            labels=("data", "fsdp", "tp")),
+    )

@@ -91,6 +91,78 @@ def _conv_out(size, k, s, p, same):
     return (size + 2 * p - k) // s + 1
 
 
+# ---------------------------------------------------------------- param roles
+
+# Role vocabulary for parameter partitioning (parallel.partition.SpecLayout
+# maps each role to a PartitionSpec over the data/fsdp/tp mesh). nn owns the
+# vocabulary and the name→role tagging; parallel owns the role→spec policy.
+ROLE_EMBEDDING = "embedding"   # lookup tables: vocab/class dim shards fsdp×tp
+ROLE_KERNEL = "kernel"         # dense/conv/recurrent projection matrices
+ROLE_NORM = "norm"             # per-feature scales (gamma/beta/alpha/ln_*)
+ROLE_BIAS = "bias"             # per-unit offsets (and scalar margins)
+
+# Canonical param-name → role table covering every name produced by the
+# bundled layers and functional models. Partitioning treats an unknown name
+# as UNCOVERED (no silent replication) — add new names here, or override
+# ``Layer.param_roles`` where a name's role is layer-dependent.
+_PARAM_NAME_ROLES = {
+    # conf.py layers
+    "W": ROLE_KERNEL, "RW": ROLE_KERNEL, "b": ROLE_BIAS,
+    "gamma": ROLE_NORM, "beta": ROLE_NORM,
+    "pi": ROLE_BIAS, "pf": ROLE_BIAS, "po": ROLE_BIAS,  # LSTM peepholes [H]
+    "dW": ROLE_KERNEL, "pW": ROLE_KERNEL,  # separable conv depth/pointwise
+    # layers_ext / layers_tail / attention / capsules
+    "rb": ROLE_BIAS,                       # GRU reset_after bias
+    "alpha": ROLE_NORM,                    # PReLU per-feature slope
+    "centers": ROLE_EMBEDDING,             # CenterLoss per-class centers
+    "V": ROLE_KERNEL, "w": ROLE_KERNEL, "r": ROLE_BIAS,  # OCNN
+    "Wq": ROLE_KERNEL, "Wk": ROLE_KERNEL, "Wv": ROLE_KERNEL,
+    "Wo": ROLE_KERNEL, "Wr": ROLE_KERNEL,
+    "Wh": ROLE_KERNEL, "Wx": ROLE_KERNEL,
+    "Q": ROLE_EMBEDDING,                   # learned query table [n_queries, proj]
+    # functional transformer (models/transformer.py)
+    "tok": ROLE_EMBEDDING, "pos": ROLE_EMBEDDING, "seg": ROLE_EMBEDDING,
+    "qkv_w": ROLE_KERNEL, "out_w": ROLE_KERNEL,
+    "ffn_w1": ROLE_KERNEL, "ffn_w2": ROLE_KERNEL,
+    "qkv_b": ROLE_BIAS, "out_b": ROLE_BIAS,
+    "ffn_b1": ROLE_BIAS, "ffn_b2": ROLE_BIAS, "out_bias": ROLE_BIAS,
+    "ln_scale": ROLE_NORM, "ln_bias": ROLE_NORM,
+    "ln1_scale": ROLE_NORM, "ln1_bias": ROLE_NORM,
+    "ln2_scale": ROLE_NORM, "ln2_bias": ROLE_NORM,
+}
+
+
+def param_role(name: str, leaf=None) -> Optional[str]:
+    """Role for one param leaf by name (None = uncovered). Falls back to
+    suffix patterns so new functional-model names with conventional suffixes
+    (``*_w``/``*_b``/``*_scale``/``*_bias``/``*embed*``) stay covered."""
+    if name in _PARAM_NAME_ROLES:
+        return _PARAM_NAME_ROLES[name]
+    ln = name.lower()
+    if "embed" in ln:
+        return ROLE_EMBEDDING
+    if ln.endswith("_scale") or ln.endswith("_gain"):
+        return ROLE_NORM
+    if ln.endswith("_bias") or ln.endswith("_b"):
+        return ROLE_BIAS
+    if ln.endswith("_w") or ln.endswith("_kernel"):
+        return ROLE_KERNEL
+    return None
+
+
+def classify_param_tree(params) -> Any:
+    """Mirror a params (sub)tree with role strings / None per leaf. Nested
+    containers (Bidirectional fwd/bwd, graph node dicts, transformer block
+    lists) recurse; leaf role comes from the leaf's own key name."""
+    if isinstance(params, dict):
+        return {k: (classify_param_tree(v) if isinstance(v, (dict, list, tuple))
+                    else param_role(k, v))
+                for k, v in params.items()}
+    if isinstance(params, (list, tuple)):
+        return type(params)(classify_param_tree(v) for v in params)
+    return None  # bare leaf with no name context
+
+
 # --------------------------------------------------------------- base config
 
 
@@ -121,6 +193,13 @@ class Layer:
 
     def has_params(self) -> bool:
         return True
+
+    def param_roles(self, params) -> Any:
+        """Role tree mirroring ``init_params`` output (see the role
+        vocabulary above). The default classifies each leaf by its canonical
+        param name; layers whose names are role-ambiguous (EmbeddingLayer's
+        ``W`` is a table, not a projection) override."""
+        return classify_param_tree(params)
 
     def _apply_dropout(self, x, training, rng):
         """DL4J conf .dropOut(...): a float (probability of RETAINING an
@@ -644,6 +723,11 @@ class EmbeddingLayer(Layer):
         if self.has_bias:
             z = z + params["b"]
         return act.get(self.activation)(z)
+
+    def param_roles(self, params) -> Any:
+        # W is the [vocab, n_out] lookup TABLE here, not a projection kernel
+        return {k: (ROLE_EMBEDDING if k == "W" else param_role(k, v))
+                for k, v in params.items()}
 
 
 @dataclass

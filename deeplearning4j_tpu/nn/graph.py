@@ -367,7 +367,7 @@ class ComputationGraph(_LazyScoreMixin):
                 outs, _ = self._forward(params, bn_state, inputs, training=False, rng=None)
                 return outs
 
-            self._jit_cache["output"] = jax.jit(fwd)
+            self._jit_cache["output"] = jax.jit(fwd)  # donate-ok: read-only inference; params must survive the call
         inputs = self._coerce_inputs(list(features) if len(features) > 1 else features[0])
         outs = self._jit_cache["output"](self.params_, self.bn_state, inputs)
         return [NDArray(outs[o]) for o in self.conf.network_outputs]

@@ -654,7 +654,7 @@ class MultiLayerNetwork(_LazyScoreMixin):
     def output(self, x, training: bool = False) -> NDArray:
         """Forward to final layer activations (MultiLayerNetwork.output)."""
         if "output" not in self._jit_cache:
-            self._jit_cache["output"] = jax.jit(self._inference_fn())
+            self._jit_cache["output"] = jax.jit(self._inference_fn())  # donate-ok: read-only inference; params must survive the call
         xj = jnp.asarray(x.numpy() if hasattr(x, "numpy") else x,
                          self._features_dtype())
         return NDArray(self._jit_cache["output"](self.params_, self.bn_state, xj))
@@ -706,7 +706,7 @@ class MultiLayerNetwork(_LazyScoreMixin):
                 out = layer.forward(params.get(str(i), {}), h, it, training=False, rng=None)
                 return out, new_rnn
 
-            self._jit_cache["rnn_step"] = jax.jit(fwd)
+            self._jit_cache["rnn_step"] = jax.jit(fwd)  # donate-ok: streaming inference; params/rnn state are reused across calls
         out, self._rnn_state = self._jit_cache["rnn_step"](self.params_, self.bn_state, self._rnn_state, xj)
         return NDArray(out)
 

@@ -1,4 +1,5 @@
-from .mesh import MeshSpec, build_mesh, device_count
+from .mesh import MeshSpec, build_mesh, device_count, mesh_from_shape
+from .partition import Partitioner, PartitionReport, SpecLayout, param_role_tree
 from .sharding import ShardingRules, DP, TP_COLUMN, TP_ROW, replicated, shard_batch, shard_params
 from .trainer import (
     MultiProcessTrainer,
@@ -15,6 +16,11 @@ __all__ = [
     "MeshSpec",
     "build_mesh",
     "device_count",
+    "mesh_from_shape",
+    "Partitioner",
+    "PartitionReport",
+    "SpecLayout",
+    "param_role_tree",
     "ShardingRules",
     "DP",
     "TP_COLUMN",

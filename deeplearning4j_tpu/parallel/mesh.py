@@ -24,6 +24,13 @@ AXIS_MODEL = "model"
 AXIS_PIPE = "pipe"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
+# ISSUE 9: the sharded-parameter training layout axes. ``fsdp`` shards
+# parameter/optimizer STORAGE (ZeRO-3 style — GSPMD all-gathers for compute);
+# ``tp`` shards a single layer's math (Megatron style). ``data`` stays the
+# batch axis. Keep tp LAST: it is the most communication-heavy axis and the
+# last mesh axis gets ICI-nearest neighbors (see build_mesh).
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
 
 
 def device_count() -> int:
@@ -72,6 +79,18 @@ def build_mesh(spec: Optional[MeshSpec] = None, devices: Optional[Sequence] = No
     shape = tuple(sizes.values())
     dev_array = np.asarray(devs).reshape(shape)
     return Mesh(dev_array, names)
+
+
+def mesh_from_shape(shape: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a multi-axis mesh from an axis-size map, e.g.
+    ``{"data": 1, "fsdp": 4, "tp": 2}``. Axis ORDER follows the dict (data
+    outermost → DCN-friendly; tp innermost → ICI neighbors). One axis may be
+    -1 to absorb the remaining devices. Size-1 axes are kept — a degenerate
+    axis keeps every PartitionSpec naming it valid, so the same SpecLayout
+    runs unchanged from 1 chip to a pod."""
+    if not shape:
+        raise ValueError("mesh_from_shape needs at least one axis")
+    return build_mesh(MeshSpec(axes=dict(shape)), devices=devices)
 
 
 def data_parallel_mesh(n: Optional[int] = None) -> Mesh:

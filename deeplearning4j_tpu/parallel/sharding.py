@@ -118,8 +118,19 @@ def batch_sharding(mesh: Mesh, data_axis: str = AXIS_DATA) -> NamedSharding:
     batch_sharding(mesh))`` distributes a host batch to the whole gang in a
     single one-shot redistribution (Rink et al., arXiv:2112.01075) — this is
     what ``ParallelTrainer.batch_sharding`` and ``DevicePrefetchIterator``
-    thread through the data-parallel input pipeline."""
-    return NamedSharding(mesh, P(data_axis))
+    thread through the data-parallel input pipeline.
+
+    Works on any mesh rank (ISSUE 9): on a multi-axis ``data/fsdp/tp``
+    layout mesh the batch shards over ``data`` and REPLICATES over the
+    parameter axes (fsdp/tp shard storage/math, not examples); on a 1-axis
+    mesh whose sole axis has another name (a bare ``model`` or ``batch``
+    mesh) it falls back to that axis — the historical single-axis behavior.
+    A multi-axis mesh with no data axis replicates the batch."""
+    if data_axis in mesh.shape:
+        return NamedSharding(mesh, P(data_axis))
+    if len(mesh.axis_names) == 1:
+        return NamedSharding(mesh, P(mesh.axis_names[0]))
+    return NamedSharding(mesh, P())
 
 
 def shard_batch(batch, mesh: Mesh, data_axis: str = AXIS_DATA):

@@ -67,8 +67,31 @@ class ParallelTrainer:
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, data_axis: str = AXIS_DATA,
-                 sharding_rules=None):
+                 sharding_rules=None, mesh_layout=None):
         self.net = net
+        # ISSUE 9: mesh_layout=SpecLayout(data=D, fsdp=F, tp=T) turns the
+        # replicated gang into sharded-parameter training — params AND
+        # optimizer state placed per layer role over the fsdp/tp axes, batch
+        # still sharded over data. The replicated path (mesh_layout=None)
+        # is unchanged and stays the default.
+        if mesh_layout is not None and sharding_rules is not None:
+            raise ValueError("pass mesh_layout OR sharding_rules, not both")
+        self.partitioner = None
+        self.partition_report = None
+        if mesh_layout is not None:
+            from .partition import Partitioner, SpecLayout
+
+            if isinstance(mesh_layout, SpecLayout):
+                mesh_layout = Partitioner(mesh_layout, mesh=mesh)
+            elif mesh is not None and mesh is not mesh_layout.mesh:
+                # a pre-built Partitioner owns its mesh; silently dropping a
+                # different explicit mesh would train on the wrong devices
+                raise ValueError(
+                    "mesh conflicts with mesh_layout's Partitioner mesh — "
+                    "pass the mesh to Partitioner(...), or pass a SpecLayout")
+            mesh = mesh_layout.mesh
+            data_axis = mesh_layout.layout.data_axis
+            self.partitioner = mesh_layout
         self.mesh = mesh or build_mesh(**{data_axis: -1})
         self.data_axis = data_axis
         # VERDICT r2: nets can now train tensor-parallel through the standard
@@ -105,13 +128,19 @@ class ParallelTrainer:
         if self._placed:
             return
         n = self.net
-        if self.sharding_rules is None:
+        if self.partitioner is not None:
+            # sharded-parameter path: params + opt state per layer role over
+            # fsdp/tp (a sharded-checkpoint restore already placed them —
+            # the partitioner passes equal-sharding leaves through untouched)
+            self.partition_report = self.partitioner.partition_net(n)
+        elif self.sharding_rules is None:
             n.params_ = self._replicate(n.params_)
             n.updater_state = self._replicate(n.updater_state)
+            n.bn_state = self._replicate(n.bn_state)
         else:
             n.params_, specs = self.sharding_rules.shard_tree(n.params_, self.mesh)
             n.updater_state = self._shard_state_like(n.updater_state, specs)
-        n.bn_state = self._replicate(n.bn_state)
+            n.bn_state = self._replicate(n.bn_state)
         self._placed = True
 
     def _shard_state_like(self, state, param_specs):
@@ -132,6 +161,18 @@ class ParallelTrainer:
             else:
                 out[k] = self._replicate(sub)
         return out
+
+    # -- checkpointing ------------------------------------------------------
+
+    def checkpointer(self, directory: str, **kw):
+        """A :class:`~deeplearning4j_tpu.serde.checkpoint.TrainingCheckpointer`
+        carrying this trainer's partitioner, so sharded gangs save/restore
+        per-rank shards with the layout recorded in the manifest (and a
+        mismatched-layout restore fails loudly instead of mixing shards)."""
+        from ..serde.checkpoint import TrainingCheckpointer
+
+        kw.setdefault("partitioner", self.partitioner)
+        return TrainingCheckpointer(directory, **kw)
 
     # -- input staging ------------------------------------------------------
 
@@ -229,6 +270,7 @@ class ParallelTrainer:
         return self.net
 
     def _fit_batch(self, ds: DataSet):
+        self._place_net()  # idempotent: direct _fit_batch callers skip fit()
         b = ds.num_examples()  # shape read only: never syncs a device batch
         rem = b % self._ndata
         if rem:
@@ -340,13 +382,14 @@ class MultiProcessTrainer(ParallelTrainer):
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, data_axis: str = AXIS_DATA,
-                 sharding_rules=None):
+                 sharding_rules=None, mesh_layout=None):
         if sharding_rules is not None:
             raise NotImplementedError(
                 "sharding_rules placement uses jax.device_put, which cannot "
-                "address a multi-process mesh; multi-process TP needs "
-                "make_array_from_process_local_data per-shard construction")
-        super().__init__(net, mesh, data_axis)
+                "address a multi-process mesh; use mesh_layout=SpecLayout(...) "
+                "— the partitioner places shards via make_array_from_callback, "
+                "which works across process boundaries")
+        super().__init__(net, mesh, data_axis, mesh_layout=mesh_layout)
 
     def prefetch(self, iterator, buffer_size: int = 2):
         """Host-staged prefetch only: one-shot sharded ``jax.device_put``
@@ -374,6 +417,7 @@ class MultiProcessTrainer(ParallelTrainer):
         # multiprocess input pipelines must feed divisible LOCAL batches
         import jax
 
+        self._place_net()  # idempotent: direct _fit_batch callers skip fit()
         b = ds.num_examples()
         local = max(1, len(self.mesh.devices.flat) // jax.process_count())
         if b % local:

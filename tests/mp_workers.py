@@ -381,6 +381,98 @@ def w2v_shard_train():
     })
 
 
+def fsdp_train():
+    """ISSUE 9 acceptance target: a gang training with SHARDED parameters —
+    ``MultiProcessTrainer(mesh_layout=SpecLayout(data=1, fsdp=F, tp=T))``
+    places params AND optimizer state over the fsdp/tp axes spanning the
+    process boundary. Modes (TDL_MP_MODE):
+
+    - ``train``: N steps on deterministic global batches (data axis is 1, so
+      every rank feeds the full batch and GSPMD shards the math); layout-
+      stamped sharded checkpoints via ``trainer.checkpointer`` when
+      TDL_MP_CKPT is set.
+    - ``restore``: a FRESH gang restores the sharded checkpoint (each rank
+      reads only its shards) and writes the param fingerprint — the parent
+      asserts exact parity with the trained gang, and that a mismatched
+      TDL_MP_FSDP/TDL_MP_TP gang dies with the layout-mismatch error.
+
+    Every rank reports ``tdl_param_bytes_per_rank`` so the parent can assert
+    per-rank bytes shrink ~linearly with the fsdp axis size."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.monitoring.partition import partition_metrics
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+    from deeplearning4j_tpu.parallel.partition import Partitioner, SpecLayout
+    from deeplearning4j_tpu.parallel.trainer import MultiProcessTrainer
+
+    col = ProcessCollectives()
+    rank, world = col.rank, col.world
+    data = int(os.environ.get("TDL_MP_DATA", "1"))
+    fsdp = int(os.environ.get("TDL_MP_FSDP", "-1"))
+    tp = int(os.environ.get("TDL_MP_TP", "1"))
+    mode = os.environ.get("TDL_MP_MODE", "train")
+    steps = int(os.environ.get("TDL_MP_STEPS", "4"))
+    every = int(os.environ.get("TDL_MP_CKPT_EVERY", "2"))
+
+    # every dim divisible by 4 so a 4-way fsdp axis shards EVERY leaf —
+    # per-rank bytes then shrink exactly linearly (no replicated remainder)
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    partitioner = Partitioner(SpecLayout(data=data, fsdp=fsdp, tp=tp))
+    trainer = MultiProcessTrainer(net, mesh_layout=partitioner)
+    ck = (trainer.checkpointer(os.environ["TDL_MP_CKPT"], async_write=False)
+          if "TDL_MP_CKPT" in os.environ else None)
+
+    def batch(step, n=8):
+        rs = np.random.RandomState(2000 + step)
+        x = rs.rand(n, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, n)]
+        return x, y
+
+    losses = []
+    if mode == "restore":
+        if not ck or not ck.restore(net):  # mismatch raises BEFORE here
+            raise RuntimeError("restore mode found no checkpoint")
+        trainer._place_net()  # pass-through: shards already placed
+    else:
+        for step in range(steps):
+            x, y = batch(step)
+            trainer.fit([DataSet(x, y)])  # data axis =1: full global batch
+            losses.append(float(net.score_))
+            if ck is not None and (step + 1) % every == 0:
+                col.barrier(f"fsdp-ck-{step}")
+                ck.save(net)
+                col.barrier(f"fsdp-ck-done-{step}")
+
+    # device-side fingerprint (replicated scalars): the flat host view would
+    # gather non-addressable shards — exactly what sharded state forbids
+    psum = float(sum(jnp.sum(w) for w in jax.tree.leaves(net.params_)))
+    pnorm = float(jnp.sqrt(sum(jnp.sum(jnp.square(w))
+                               for w in jax.tree.leaves(net.params_))))
+    m = partition_metrics()
+    rep = trainer.partition_report
+    col.barrier("fsdp-done")
+    _write(rank, {
+        "losses": losses, "param_sum": psum, "param_norm": pnorm,
+        "iteration": int(net.iteration),
+        "bytes_params": m.param_bytes.labels("params").value,
+        "bytes_opt": m.param_bytes.labels("opt_state").value,
+        "params_bytes_total": rep.params_bytes_total,
+        "local_devices": jax.local_device_count(),
+        "mesh": {a: int(s) for a, s in trainer.mesh.shape.items()},
+        "global_devices": jax.device_count(),
+    })
+
+
 def tp_train():
     """Cross-process TENSOR-parallel numerics (r5 hygiene, VERDICT r4 weak
     #7): a dp×tp transformer step over a global 2-process mesh — the tp

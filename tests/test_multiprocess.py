@@ -170,6 +170,74 @@ def test_w2v_embedding_shards_across_processes(tmp_path):
     assert r0["within"] > r0["across"] + 0.1, (r0["within"], r0["across"])
 
 
+@pytest.mark.slow
+def test_fsdp_param_bytes_shrink_with_fsdp_axis(tmp_path):
+    """ISSUE 9 acceptance: per-rank param + optimizer-state bytes shrink
+    ~linearly with the fsdp axis size, read from the
+    ``tdl_param_bytes_per_rank`` gauge each rank publishes. The toy net's
+    dims all divide 4, so fsdp=4 sharding is EXACTLY linear:
+    rank bytes = total × local_devices / fsdp."""
+    (tmp_path / "f4").mkdir()
+    (tmp_path / "f1").mkdir()
+    env4 = {"TDL_MP_FSDP": "4", "TDL_MP_STEPS": "2"}
+    env1 = {"TDL_MP_DATA": "-1", "TDL_MP_FSDP": "1", "TDL_MP_STEPS": "2"}
+    r4 = _run("fsdp_train", tmp_path / "f4", extra_env=env4)
+    r1 = _run("fsdp_train", tmp_path / "f1", extra_env=env1)
+
+    total = r4[0]["params_bytes_total"]
+    local = r4[0]["local_devices"]
+    for r in r4:
+        assert r["mesh"] == {"data": 1, "fsdp": 4, "tp": 1}
+        # every leaf shards 4 ways → exactly total/4 per device copy
+        assert r["bytes_params"] == total * local / 4
+        # Adam m/v shard identically to their params → exactly 2x
+        assert r["bytes_opt"] == 2 * r["bytes_params"]
+    for r in r1:
+        # fsdp=1 replicates: every local device holds the full tree
+        assert r["bytes_params"] == total * local
+    # the linear-shrink headline: fsdp=4 holds 1/4 of the replicated bytes
+    assert r1[0]["bytes_params"] == 4 * r4[0]["bytes_params"]
+    # both gangs actually trained (finite, rank-identical losses)
+    np.testing.assert_allclose(r4[0]["losses"], r4[1]["losses"], rtol=1e-6)
+    assert np.isfinite(r4[0]["losses"]).all()
+
+
+@pytest.mark.slow
+def test_fsdp_sharded_checkpoint_roundtrip_and_mismatch(tmp_path):
+    """ISSUE 9 satellite: a 2-process fsdp gang saves layout-stamped sharded
+    checkpoints via TrainingCheckpointer; a FRESH gang with the same layout
+    restores with exact param parity (each rank reads only its shards); a
+    gang requesting a different layout dies with an error naming both
+    layouts (the ROADMAP item 5 setup)."""
+    ckdir = str(tmp_path / "ck")
+    base = {"TDL_MP_FSDP": "4", "TDL_MP_CKPT": ckdir, "TDL_MP_STEPS": "4",
+            "TDL_MP_CKPT_EVERY": "2"}
+    for d in ("a", "b"):
+        (tmp_path / d).mkdir()
+    trained = _run("fsdp_train", tmp_path / "a", extra_env=base)
+    restored = _run("fsdp_train", tmp_path / "b",
+                    extra_env={**base, "TDL_MP_MODE": "restore"})
+    for t, r in zip(trained, restored):
+        # exact: same layout means shard files map 1:1 onto the new gang
+        assert r["param_sum"] == t["param_sum"]
+        assert r["param_norm"] == t["param_norm"]
+        assert r["iteration"] == t["iteration"] == 4
+        assert r["bytes_params"] == t["bytes_params"]
+
+    # mismatched layout: fsdp=2 x tp=2 over the same devices must refuse
+    out = str(tmp_path / "mm.json")
+    results = launcher.launch(
+        f"{WORKERS}:fsdp_train", n_processes=2, n_local_devices=2,
+        extra_env={**base, "TDL_MP_MODE": "restore", "TDL_MP_FSDP": "2",
+                   "TDL_MP_TP": "2", "TDL_MP_OUT": out,
+                   "TDL_MATMUL_PRECISION": "float32"},
+        timeout=420)
+    assert any(r.returncode != 0 for r in results)
+    blob = "".join(r.stderr for r in results)
+    assert "mesh layout mismatch" in blob
+    assert "fsdp=4" in blob and "fsdp=2" in blob  # names BOTH layouts
+
+
 def test_multiprocess_tp_matches_single_process(tmp_path):
     """Tensor-parallel axis SPANNING the process boundary (r5: VERDICT r4
     weak #7 — the multi-process tier previously proved DP numerics only)."""
