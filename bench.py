@@ -73,6 +73,63 @@ def calibration_probe():
             "probe_shape": "8x(2048^2 bf16 matmul)"}
 
 
+# ------------------------------------------------------- cost observatory
+
+
+def _roofline_probe():
+    """Measured achievable matmul flops/sec in THIS window (ISSUE 10): a
+    pinned matmul chain at the effective compute dtype. The utilization a
+    config reports is achieved-model-flops over THIS number — a measured
+    roofline, so the ratio stays honest across backends and tunnel windows
+    (a vendor peak-TFLOPs constant would be fiction on the CPU smoke)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.common.precision import compute_dtype
+
+    n = 1024
+    reps = 4
+
+    @jax.jit
+    def chain(x):
+        for _ in range(reps):
+            x = (x @ x) * 1e-3 + x
+        return x
+
+    a = jnp.full((n, n), 0.5, compute_dtype())
+    chain(a).block_until_ready()  # compile outside the window
+    k = 3
+    t0 = time.perf_counter()
+    for _ in range(k):
+        a = chain(a)
+    a.block_until_ready()
+    dt = time.perf_counter() - t0
+    return 2.0 * n ** 3 * reps * k / dt
+
+
+def _utilization(flops_per_step, steps, window_s, roofline):
+    achieved = flops_per_step * steps / window_s if window_s > 0 else 0.0
+    return {"achieved_gflops_per_sec": round(achieved / 1e9, 2),
+            "roofline_gflops_per_sec": round(roofline / 1e9, 2),
+            "utilization": round(achieved / roofline, 4) if roofline else None}
+
+
+def _trim_table(table, top=12):
+    """Bench-JSON-sized view of a cost table: the top-N layers by flops plus
+    one 'others' roll-up row (ResNet-50 has ~120 rows; the gauges carry the
+    full set, the JSON line stays readable)."""
+    layers = sorted(table["layers"], key=lambda r: -r["flops"])
+    if len(layers) > top:
+        rest = layers[top:]
+        layers = layers[:top] + [{
+            "layer": f"(+{len(rest)} more)", "kind": "others",
+            "flops": sum(r["flops"] for r in rest),
+            "param_bytes": sum(r["param_bytes"] for r in rest),
+            "activation_bytes": sum(r["activation_bytes"] for r in rest),
+            "pct": round(sum(r["pct"] for r in rest), 2)}]
+    return {**table, "layers": layers}
+
+
 # ----------------------------------------------------------- step attribution
 
 
@@ -154,6 +211,16 @@ def bench_resnet50(p):
     out = {"metric": "resnet50_train_images_per_sec",
            "value": round(batch * p["steps"] / dt, 2),
            "unit": "images/sec/chip", "batch": batch, "image_size": hw}
+
+    # ISSUE 10: per-layer cost attribution + achieved-vs-roofline. Estimator
+    # only — re-lowering ResNet-50 for cost_analysis would double the
+    # config's compile bill; LeNet/BERT carry the XLA-validated tables
+    from deeplearning4j_tpu.monitoring import costmodel
+
+    table = costmodel.publish("resnet50", costmodel.layer_costs(net, batch))
+    out["cost"] = {**_trim_table(table),
+                   **_utilization(table["total_flops"], p["steps"], dt,
+                                  _roofline_probe())}
 
     # real-input-pipeline variant (SURVEY §2.3 D3 / VERDICT r2 missing #3):
     # JPEGs on disk → ImageRecordReader decode+augment → async prefetch;
@@ -500,6 +567,28 @@ def _resnet_pipeline_etl(p, jstep, params, opt, bn, rng, synthetic_ips,
 # --------------------------------------------------------------- lenet (TTA)
 
 
+def _lenet_cost(net, batch):
+    """ISSUE 10: per-layer cost table for LeNet joined against XLA
+    cost_analysis of the compiled train step, plus the live-HBM breakdown —
+    publishes tdl_model_flops_per_step / tdl_hbm_peak_bytes /
+    tdl_layer_cost_info / tdl_hbm_bytes on the process registry."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.monitoring import costmodel
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch, 1, 28, 28).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rs.randint(0, 10, batch)])
+    xla = costmodel.xla_step_cost(
+        net._train_step_fn(), net.params_, net.updater_state, net.bn_state,
+        jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32), x, y,
+        None, None, jax.random.key(0))
+    table = costmodel.publish("lenet", costmodel.layer_costs(net, batch), xla)
+    table["hbm"] = costmodel.net_hbm_breakdown(net, model="lenet")
+    return table
+
+
 def bench_lenet(p):
     from deeplearning4j_tpu.data.datasets import MnistDataSetIterator
     from deeplearning4j_tpu.models import LeNet
@@ -529,7 +618,10 @@ def bench_lenet(p):
             "unit": f"sec_to_{p['target_acc']:.0%}_acc",
             "reached": tta is not None, "final_acc": round(float(acc), 4),
             "synthetic": bool(getattr(train_it, "synthetic", False)),
-            "images_per_sec": round(images / train_time, 1)}
+            "images_per_sec": round(images / train_time, 1),
+            # ISSUE 10: where the step's flops/bytes go, validated against
+            # XLA's own count of the compiled executable ("coverage")
+            "cost": _lenet_cost(net, p["batch"])}
 
 
 # -------------------------------------------------------- graveslstm char-rnn
@@ -658,6 +750,20 @@ def bench_bert(p):
         return loss
 
     dt = timed(run_mlm, batch)
+
+    # ISSUE 10: the functional transformer's cost table, validated against
+    # XLA cost_analysis of the compiled MLM step, + roofline utilization of
+    # the timed window above
+    from deeplearning4j_tpu.models.transformer import layer_costs
+    from deeplearning4j_tpu.monitoring import costmodel
+
+    xla_cost = costmodel.xla_step_cost(step, state["params"], state["opt"],
+                                       batch, it, rng)
+    cost = costmodel.publish("transformer",
+                             layer_costs(cfg, B, T, mlm_positions=P), xla_cost)
+    cost.update(_utilization(xla_cost["flops"] or cost["total_flops"],
+                             p["steps"], dt, _roofline_probe()))
+
     # masked variant: padding mask present → the Pallas masked-flash path
     # (r4 silently fell back to the O(T^2) dense path under any mask)
     pad = np.ones((B, T), np.float32)
@@ -697,7 +803,8 @@ def bench_bert(p):
             "batch": B, "seq": T, "mlm_positions": P,
             "masked_tokens_per_sec": round(B * T * p["steps"] / dt_masked, 1),
             "squad_finetune_tokens_per_sec": round(B * T * p["steps"] / dt_squad, 1),
-            "model": "tiny" if p["tiny"] else "bert-base"}
+            "model": "tiny" if p["tiny"] else "bert-base",
+            "cost": _trim_table(cost)}
 
 
 # ------------------------------------------------- multichip: fsdp x tp bert
@@ -915,6 +1022,47 @@ BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "bert_large_fsdp": bench_fsdp}
 
 
+# -------------------------------------------------------- regression compare
+
+
+def compare_benchmarks(current: dict, old: dict, threshold: float = 0.10):
+    """Per-config throughput regressions of ``current`` vs a prior bench
+    JSON (ISSUE 10 satellite: the BENCH trajectory becomes machine-checkable).
+    Only rate metrics gate (unit contains "/s"); lower-is-better metrics like
+    time-to-accuracy are skipped. Raises ValueError on a cross-backend
+    compare — a CPU-smoke run regressing against a TPU baseline is noise,
+    not signal."""
+    if old.get("backend") != current.get("backend"):
+        raise ValueError(
+            f"cannot compare backends: current={current.get('backend')!r} "
+            f"vs old={old.get('backend')!r}")
+    regressions = []
+    old_cfgs = old.get("configs") or {}
+    for name, cur in (current.get("configs") or {}).items():
+        prev = old_cfgs.get(name)
+        if not isinstance(cur, dict) or not isinstance(prev, dict):
+            continue
+        unit = str(cur.get("unit") or "")
+        if "/s" not in unit:
+            continue
+        if str(prev.get("unit") or "") != unit:
+            # a config whose unit changed between runs is incomparable —
+            # ratioing images/sec against batches/sec fabricates a
+            # regression (or hides one behind a unit inflation)
+            continue
+        cv, pv = cur.get("value"), prev.get("value")
+        # a prior value of None/0 gives no baseline; a CURRENT value of 0
+        # against a real baseline is the worst regression there is — it must
+        # gate, not fall through a falsy check
+        if cv is None or pv is None or pv <= 0:
+            continue
+        ratio = cv / pv
+        if ratio < 1.0 - threshold:
+            regressions.append({"config": name, "old": pv, "new": cv,
+                                "ratio": round(ratio, 3), "unit": unit})
+    return regressions
+
+
 # -------------------------------------------------------- telemetry checking
 
 
@@ -977,8 +1125,30 @@ def main():
 
     backend = jax.default_backend()
     params = _scale(backend == "tpu")
-    args = [a for a in sys.argv[1:] if a != "--check-telemetry"]
+    argv = [a for a in sys.argv[1:] if a != "--check-telemetry"]
     check = "--check-telemetry" in sys.argv[1:]
+    compare_path, compare_old = None, None
+    if "--compare" in argv:
+        i = argv.index("--compare")
+        if i + 1 >= len(argv):
+            sys.exit("--compare needs a prior bench JSON path")
+        compare_path = argv[i + 1]
+        del argv[i:i + 2]
+        # load + validate NOW: a typo'd path must fail in under a second,
+        # not after the whole bench run completes
+        try:
+            with open(compare_path) as f:
+                compare_old = json.load(f)
+        except (OSError, ValueError) as e:
+            sys.exit(f"--compare cannot read {compare_path}: {e}")
+        if not isinstance(compare_old.get("configs"), dict):
+            sys.exit(f"--compare: {compare_path} is not a bench JSON "
+                     "(no 'configs' object)")
+        if compare_old.get("backend") != backend:
+            # fail before the run, not after minutes of benching
+            sys.exit(f"--compare refused: current backend {backend!r} vs "
+                     f"{compare_old.get('backend')!r} in {compare_path}")
+    args = argv
     only = args[0] if args else None
     if only and only not in BENCHES:
         sys.exit(f"unknown benchmark {only!r}; choose from: {', '.join(BENCHES)}")
@@ -1001,6 +1171,15 @@ def main():
 
     from deeplearning4j_tpu.common.precision import compute_dtype
 
+    # ISSUE 10: one SLO-alert pass over everything the run just emitted —
+    # evaluated BEFORE the registry snapshot so tdl_alert_firing rides the
+    # telemetry block (a bench run with a firing alert is visibly abnormal).
+    # after_warmup rules have no warmup mark in a one-shot bench run and
+    # stay pending — reported as such, never silently "clean"
+    from deeplearning4j_tpu.monitoring import AlertEngine
+
+    alert_rows = AlertEngine().evaluate()
+
     effective_precision = compute_dtype().__name__  # resolves 'auto' per backend
     head = results.get("resnet50") or results[names[0]]
     head_cfg = {"batch": head.get("batch"), "image_size": head.get("image_size"),
@@ -1019,6 +1198,10 @@ def main():
         # carry telemetry from here on
         "telemetry": {"compiles": recompile_wd.stats(),
                       "metrics": get_registry().snapshot()},
+        "alerts": {"firing": [a["rule"] for a in alert_rows if a["firing"]],
+                   "pending_warmup": [a["rule"] for a in alert_rows
+                                      if a["state"] == "pending_warmup"],
+                   "evaluated": len(alert_rows)},
     }
     # step-time attribution headline (ISSUE 7): the ResNet-50 pipeline's
     # phase-percentage table, mirrored into the telemetry block
@@ -1033,6 +1216,20 @@ def main():
             sys.exit("documented metric families missing/observation-free in "
                      f"the telemetry block (silently dead?): {missing}")
         print("check-telemetry: all documented bench families present",
+              file=sys.stderr)
+    if compare_path:
+        # perf-regression gate (ISSUE 10 satellite): non-zero exit on >10%
+        # per-config throughput drops vs the prior BENCH_r*.json
+        try:
+            regs = compare_benchmarks(out, compare_old)
+        except ValueError as e:
+            sys.exit(f"--compare refused: {e}")
+        if regs:
+            for r in regs:
+                print(f"REGRESSION {r['config']}: {r['old']} -> {r['new']} "
+                      f"{r['unit']} ({r['ratio']:.3f}x)", file=sys.stderr)
+            sys.exit(f"{len(regs)} config(s) regressed >10% vs {compare_path}")
+        print(f"compare: no >10% throughput regressions vs {compare_path}",
               file=sys.stderr)
 
 

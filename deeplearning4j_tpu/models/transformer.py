@@ -311,6 +311,67 @@ def loss_fn(params, batch, cfg: TransformerConfig, rng=None, train: bool = True)
     return token_ce_loss(logits, batch["labels"], batch.get("weights"))
 
 
+def layer_costs(cfg: TransformerConfig, batch: int, seq: int,
+                mlm_positions: Optional[int] = None,
+                train: bool = True) -> list:
+    """Per-layer cost rows for the functional transformer, in the same
+    ``{layer, kind, flops, param_bytes, activation_bytes}`` schema as
+    ``monitoring.costmodel.layer_costs`` — the embedding front-end, every
+    block, and the MLM head get a row each, so the cost table can say which
+    block family (attention vs FFN vs decoder) owns the step. Flops use the
+    same 2·MAC accounting as XLA's ``cost_analysis()``; ``train=True``
+    applies the fwd+bwd 3× factor (embedding gathers scatter-add on the
+    backward, counted as bytes, not flops)."""
+    B, T = batch, seq
+    D, F, V, H = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_heads
+    P = mlm_positions if mlm_positions is not None else T
+    pbytes = int(jnp.dtype(cfg.param_dtype).itemsize)
+    abytes = int(jnp.dtype(cfg.compute_dtype).itemsize)
+    factor = 3.0 if train else 1.0
+
+    # elementwise expansions as XLA's cost model counts them (measured on
+    # the CPU HLO pipeline): numerically-stable softmax ≈ 32 flops/score,
+    # tanh-approximate gelu ≈ 28 flops/element, fp32 layernorm ≈ 15/element
+    SOFTMAX, GELU, LN = 32.0, 28.0, 15.0
+    rows = [{
+        "layer": "embed", "kind": "Embedding",
+        # gathers move bytes; the layernorm + segment/position adds compute
+        "flops": (LN * T * D) * B * factor,
+        "param_bytes": (V * D + cfg.max_len * D + cfg.type_vocab * D + 2 * D) * pbytes,
+        "activation_bytes": B * T * D * abytes,
+    }]
+    per_block_fwd = (
+        2.0 * T * D * 3 * D        # qkv projection
+        + 2.0 * T * D * D          # attention output projection
+        + 4.0 * T * T * D          # QK^T and AV contractions
+        + SOFTMAX * H * T * T      # stable softmax over the scores
+        + 2.0 * 2.0 * T * D * F    # the two FFN matmuls
+        + GELU * T * F             # gelu over the FFN hidden
+        + 2.0 * LN * T * D         # the two layernorms
+        + 2.0 * T * D)             # residual adds
+    block_params = (D * 3 * D + 3 * D + D * D + D
+                    + D * F + F + F * D + D + 4 * D) * pbytes
+    for i in range(cfg.n_layers):
+        rows.append({
+            "layer": f"block{i}", "kind": "TransformerBlock",
+            "flops": per_block_fwd * B * factor,
+            "param_bytes": block_params,
+            "activation_bytes": B * T * D * abytes,
+        })
+    rows.append({
+        "layer": "mlm_head", "kind": "MlmHead",
+        "flops": (2.0 * P * D * D       # dense projection
+                  + GELU * P * D        # gelu on the projection
+                  + LN * P * D          # layernorm
+                  + 2.0 * P * D * V     # tied-decoder projection
+                  + 8.0 * P * V         # token cross-entropy (logsumexp)
+                  ) * B * factor,
+        "param_bytes": (D * D + D + 2 * D + V) * pbytes,
+        "activation_bytes": B * P * V * 4,  # fp32 logits
+    })
+    return rows
+
+
 def make_train_step(cfg: TransformerConfig, updater):
     """One whole-graph XLA train step: loss+grads+updater+apply, donated state."""
 

@@ -12,10 +12,18 @@
 - :mod:`.aggregate` — per-process metrics spools merged into ONE
   proc/rank-labeled ``/metrics`` with derived straggler gauges (ISSUE 7);
 - :mod:`.flight` — the flight recorder: a bounded ring of structured events
-  every process appends to, merged into ``postmortem.json`` on gang failure.
+  every process appends to, merged into ``postmortem.json`` on gang failure;
+- :mod:`.costmodel` — per-layer FLOPs/bytes attribution joined against XLA
+  ``cost_analysis()`` of the compiled step, plus the live-HBM breakdown
+  (ISSUE 10);
+- :mod:`.alerts` — declarative SLO rules evaluated at scrape time, served
+  at ``UIServer /alerts``, firing edges recorded into the flight ring.
 """
 
 from .aggregate import MetricsSpooler, maybe_spool, merged_prometheus
+from .alerts import AlertEngine, AlertRule, default_rules
+from .costmodel import (cost_table, layer_costs, live_hbm_breakdown,
+                        net_hbm_breakdown, xla_step_cost)
 from .etl import etl_metrics
 from .flight import FlightRecorder, get_flight_recorder, set_flight_recorder
 from .heartbeat import HeartbeatWriter, maybe_beat, read_heartbeat
@@ -31,6 +39,14 @@ from .watchdogs import (DeviceMemoryWatchdog, RecompileWatchdog, active,
                         signature_of)
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
+    "default_rules",
+    "cost_table",
+    "layer_costs",
+    "live_hbm_breakdown",
+    "net_hbm_breakdown",
+    "xla_step_cost",
     "Counter",
     "Gauge",
     "Histogram",

@@ -53,6 +53,12 @@ SPOOL_PREFIX = "tdl_metrics_"
 STEP_TIME_FAMILIES = ("tdl_step_wall_seconds", "tdl_parallel_step_seconds",
                       "tdl_step_duration_seconds")
 
+#: families that exist only at merge time (computed by derive_straggler, no
+#: registry declares them). Alert rules may reference these; the alert-rule
+#: lint unions them with the registry-declared set.
+DERIVED_FAMILIES = ("tdl_step_time_skew_ratio", "tdl_step_time_slowest_rank",
+                    "tdl_step_time_mean_seconds")
+
 
 class MetricsSpooler:
     """Periodically snapshot one registry to a per-process spool file."""

@@ -1,0 +1,335 @@
+"""SLO alert engine — declarative threshold rules over the metrics plane.
+
+The observability stack so far records everything and judges nothing: a
+straggling rank, an input-starved fit loop, a recompile storm or a server
+about to shed load all look like "numbers on /metrics" until a human reads
+them. This module closes the loop (ISSUE 10 layer 3, the measurement side of
+ROADMAP 2's SLO story):
+
+- an :class:`AlertRule` names ONE metric family, an aggregation over its
+  series (across every proc in an aggregated scrape), a comparison and a
+  threshold — plus two modifiers: ``ratio_of`` (divide by another family's
+  aggregate, e.g. HBM in-use over HBM limit) and ``after_warmup`` (compare
+  the INCREASE since :meth:`AlertEngine.mark_warmup_done`, e.g. "any XLA
+  compile after warmup is churn");
+- an :class:`AlertEngine` evaluates its rules **at scrape time** over the
+  local registry plus (when attached) the metrics-spool dir — the same
+  merge ``/metrics`` serves, including the derived straggler gauges — and
+  serves the result at ``UIServer /alerts``;
+- a rule's rising edge records an ``alert`` event in the flight recorder,
+  so firing alerts land on the postmortem timeline next to the step/compile
+  events that explain them, and increments
+  ``tdl_alerts_fired_total{rule}``; the level is continuously exported as
+  ``tdl_alert_firing{rule}`` 0/1 gauges.
+
+Rules reference metric families by name; the repo lint
+(tests/test_alerts.py) fails any rule naming a family no registry declares
+— renaming a metric cannot silently rot the alert that watches it.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import flight
+from .aggregate import derive_straggler, read_spools
+from .registry import MetricsRegistry, get_registry
+
+log = logging.getLogger(__name__)
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+}
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """One declarative SLO rule over a metric family.
+
+    ``agg`` folds the family's series (across labelsets AND procs) into one
+    number: ``max``/``min``/``sum``, or ``mean`` (histograms: sum/count —
+    e.g. mean queue wait). Histogram families under ``max``/``sum`` read the
+    observation COUNT. ``ratio_of`` divides PER SERIES — each numerator
+    series over the same-labels series of the denominator family in the
+    same snapshot (each device's in-use over that device's limit) — and the
+    agg then folds the ratios. ``after_warmup`` compares the increase since
+    the engine's warmup mark instead of the absolute value (the rule stays
+    ``pending_warmup`` until :meth:`AlertEngine.mark_warmup_done` is
+    called)."""
+
+    name: str
+    family: str
+    op: str = ">"
+    threshold: float = 0.0
+    agg: str = "max"
+    ratio_of: Optional[str] = None
+    after_warmup: bool = False
+    severity: str = "warning"
+    description: str = ""
+
+    def __post_init__(self):
+        if self.op not in _OPS:
+            raise ValueError(f"unknown op {self.op!r} (use {sorted(_OPS)})")
+        if self.agg not in ("max", "min", "sum", "mean"):
+            raise ValueError(f"unknown agg {self.agg!r}")
+
+
+def default_rules(queue_depth_hwm: float = 48, skew_ratio: float = 1.5,
+                  hbm_headroom_frac: float = 0.9) -> Tuple[AlertRule, ...]:
+    """The stock SLO rules (ISSUE 10): straggler skew, input-starved steps,
+    serving queue-depth high watermark, recompile-after-warmup, HBM
+    headroom. Compose with your own: ``AlertEngine(default_rules() + (...,))``."""
+    return (
+        AlertRule(
+            "straggler_skew", "tdl_step_time_skew_ratio", ">", skew_ratio,
+            description="slowest rank's mean step wall exceeds the fastest "
+                        "rank's by the threshold ratio — one rank is "
+                        "dragging the gang"),
+        AlertRule(
+            "input_starved_steps", "tdl_input_starved_steps_total", ">", 0,
+            agg="sum", after_warmup=True,
+            description="train steps blocked on the input pipeline after "
+                        "warmup — ETL or h2d staging is the wall"),
+        AlertRule(
+            "inference_queue_depth_hwm", "tdl_inference_queue_depth", ">=",
+            queue_depth_hwm,
+            description="serving admission queue at its high watermark — "
+                        "backpressure (429s) is imminent"),
+        AlertRule(
+            "recompiles_after_warmup", "tdl_xla_compiles_total", ">", 0,
+            agg="sum", after_warmup=True, severity="critical",
+            description="XLA compiled after warmup — shape churn is "
+                        "recompiling the step executable (pad or bucket "
+                        "minibatch shapes)"),
+        AlertRule(
+            "hbm_headroom", "tdl_device_memory_bytes_in_use", ">",
+            hbm_headroom_frac, ratio_of="tdl_device_memory_limit_bytes",
+            severity="critical",
+            description="device memory in use is above the headroom "
+                        "fraction of the reported HBM limit — the next "
+                        "allocation spike OOMs"),
+    )
+
+
+def alert_metrics(registry: Optional[MetricsRegistry] = None):
+    """Get-or-create the alert families (one declaration site)."""
+    r = registry or get_registry()
+    return (
+        r.gauge("tdl_alert_firing",
+                "1 while the named alert rule's condition holds, else 0",
+                labels=("rule",)),
+        r.counter("tdl_alerts_fired_total",
+                  "Rising edges of the named alert rule (ok → firing)",
+                  labels=("rule",)),
+    )
+
+
+# ------------------------------------------------------------------- engine
+
+
+def _series_values(fam: dict, agg: str) -> List[float]:
+    vals = []
+    for s in fam.get("series", []):
+        if fam.get("type") == "histogram":
+            if agg == "mean":
+                if s.get("count", 0) > 0:
+                    vals.append(float(s.get("sum", 0.0)) / s["count"])
+            else:
+                vals.append(float(s.get("count", 0)))
+        elif "value" in s:
+            vals.append(float(s["value"]))
+    return vals
+
+
+def _fold(vals: List[float], agg: str) -> Optional[float]:
+    if not vals:
+        return None
+    if agg == "max":
+        return max(vals)
+    if agg == "min":
+        return min(vals)
+    if agg == "sum":
+        return sum(vals)
+    return sum(vals) / len(vals)  # mean
+
+
+class AlertEngine:
+    """Evaluates rules over the local registry + (optionally) a metrics
+    spool dir, at scrape time. Stateless between evaluations except for the
+    warmup baselines and the previous firing set (edge detection)."""
+
+    def __init__(self, rules: Optional[Sequence[AlertRule]] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 spool_dir: Optional[str] = None):
+        self.rules: Tuple[AlertRule, ...] = tuple(
+            default_rules() if rules is None else rules)
+        names = [r.name for r in self.rules]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(f"duplicate alert rule names: {sorted(dupes)}")
+        self.registry = registry if registry is not None else get_registry()
+        self.spool_dir = spool_dir
+        self._warmup_base: Dict[str, float] = {}
+        self._warmup_marked = False
+        self._was_firing: Dict[str, bool] = {}
+        # /alerts is served by a ThreadingHTTPServer: concurrent scrapes
+        # must not both take the same rising edge (double-counted fires,
+        # duplicate flight events) or race the warmup baselines
+        self._eval_lock = threading.Lock()
+        self._firing_gauge, self._fired_counter = alert_metrics(self.registry)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def _snapshots(self) -> List[dict]:
+        """Every metrics snapshot in scope: the local registry, every spool,
+        and the derived straggler gauges presented as a pseudo-snapshot (so
+        rules can reference the same derived families /metrics exposes)."""
+        snaps = [self.registry.snapshot()]
+        if self.spool_dir:
+            spools = read_spools(self.spool_dir)
+            snaps.extend(s.get("snapshot") or {} for s in spools)
+            derived = derive_straggler(spools)
+            if derived:
+                snaps.append({
+                    "tdl_step_time_skew_ratio": {"type": "gauge", "series": [
+                        {"labels": {}, "value": derived["skew_ratio"]}]},
+                    "tdl_step_time_slowest_rank": {"type": "gauge", "series": [
+                        {"labels": {}, "value": derived["slowest_rank"]}]},
+                    "tdl_step_time_mean_seconds": {"type": "gauge", "series": [
+                        {"labels": {"rank": str(r)}, "value": v}
+                        for r, v in derived["mean_step_seconds"].items()]},
+                })
+        return snaps
+
+    def _aggregate(self, snaps: List[dict], family: str,
+                   agg: str) -> Optional[float]:
+        vals: List[float] = []
+        for snap in snaps:
+            fam = snap.get(family)
+            if fam:
+                vals.extend(_series_values(fam, agg))
+        return _fold(vals, agg)
+
+    def _ratio_values(self, snaps: List[dict],
+                      rule: AlertRule) -> List[float]:
+        """Per-SERIES ratios: numerator and denominator are paired within
+        the same snapshot by identical labels (each device's in-use over
+        THAT device's limit), then the agg folds the ratios. Folding the
+        two families independently would let one proc's huge denominator
+        (a 64GB CPU host limit) hide another proc's 97%-full TPU."""
+        ratios: List[float] = []
+        for snap in snaps:
+            num_fam, den_fam = snap.get(rule.family), snap.get(rule.ratio_of)
+            if not num_fam or not den_fam:
+                continue
+            denoms = {}
+            for s in den_fam.get("series", []):
+                vals = _series_values({**den_fam, "series": [s]}, rule.agg)
+                if vals:
+                    denoms[tuple(sorted((s.get("labels") or {}).items()))] = vals[0]
+            for s in num_fam.get("series", []):
+                den = denoms.get(
+                    tuple(sorted((s.get("labels") or {}).items())))
+                if not den:
+                    continue
+                vals = _series_values({**num_fam, "series": [s]}, rule.agg)
+                if vals:
+                    ratios.append(vals[0] / den)
+        return ratios
+
+    def _folded_value(self, snaps: List[dict],
+                      rule: AlertRule) -> Optional[float]:
+        """The rule's aggregate (ratio applied) BEFORE any warmup-baseline
+        subtraction — the one folding path both live evaluation and the
+        warmup snapshot use, so the two can never drift apart."""
+        if rule.ratio_of is not None:
+            return _fold(self._ratio_values(snaps, rule), rule.agg)
+        return self._aggregate(snaps, rule.family, rule.agg)
+
+    def _rule_value(self, snaps: List[dict], rule: AlertRule):
+        """(value, state) — value is what the threshold compares against."""
+        v = self._folded_value(snaps, rule)
+        if v is None:
+            return None, "no_data"
+        if rule.after_warmup:
+            if not self._warmup_marked:
+                return None, "pending_warmup"
+            v = v - self._warmup_base.get(rule.name, 0.0)
+        return v, "ok"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def mark_warmup_done(self) -> None:
+        """Snapshot the current value of every ``after_warmup`` rule as its
+        baseline: compiles/starvation during warmup are expected, growth
+        afterwards is the anomaly. Call once the steady state is reached
+        (e.g. after the first epoch / serving warmup)."""
+        snaps = self._snapshots()
+        with self._eval_lock:
+            for rule in self.rules:
+                if not rule.after_warmup:
+                    continue
+                v = self._folded_value(snaps, rule)
+                self._warmup_base[rule.name] = v if v is not None else 0.0
+            self._warmup_marked = True
+
+    def evaluate(self) -> List[dict]:
+        """One scrape-time pass: every rule's current value, threshold and
+        firing state. Rising edges land in the flight recorder (and the
+        fired counter); the 0/1 level lands in ``tdl_alert_firing``.
+        Serialized: concurrent scrapes of ``/alerts`` must not both take
+        the same rising edge."""
+        snaps = self._snapshots()
+        with self._eval_lock:
+            return self._evaluate_locked(snaps)
+
+    def _evaluate_locked(self, snaps: List[dict]) -> List[dict]:
+        out = []
+        for rule in self.rules:
+            value, state = self._rule_value(snaps, rule)
+            firing = bool(value is not None
+                          and _OPS[rule.op](value, rule.threshold))
+            if firing:
+                state = "firing"
+            was = self._was_firing.get(rule.name, False)
+            if firing and not was:
+                self._fired_counter.labels(rule.name).inc()
+                # black-box breadcrumb: the postmortem shows the alert ON the
+                # timeline, between the events that caused it
+                flight.record("alert", rule=rule.name, value=value,
+                              threshold=rule.threshold,
+                              severity=rule.severity, family=rule.family)
+                log.warning("alert %s firing: %s %s %s (%s=%.6g)",
+                            rule.name, rule.family, rule.op, rule.threshold,
+                            rule.agg, value)
+            self._was_firing[rule.name] = firing
+            self._firing_gauge.labels(rule.name).set(1.0 if firing else 0.0)
+            out.append({
+                "rule": rule.name,
+                "family": rule.family,
+                "op": rule.op,
+                "threshold": rule.threshold,
+                "agg": rule.agg,
+                "ratio_of": rule.ratio_of,
+                "after_warmup": rule.after_warmup,
+                "severity": rule.severity,
+                "description": rule.description,
+                # an infinite skew (a rank reporting 0s steps) still fires,
+                # but the Infinity token is not strict JSON — report null
+                "value": value if (value is None or math.isfinite(value))
+                else None,
+                "state": state,
+                "firing": firing,
+            })
+        return out
+
+    def firing(self) -> List[str]:
+        """Names of currently-firing rules (evaluates)."""
+        return [a["rule"] for a in self.evaluate() if a["firing"]]

@@ -1,0 +1,255 @@
+"""Cost & memory attribution — where the FLOPs and HBM bytes actually go.
+
+The monitoring plane so far (PR 1, PR 7) answers *how fast* a step is; this
+module answers *where the cost lives*, the measurement foundation for the
+recompile/autotune work (ROADMAP 4) and the serving SLOs (ROADMAP 2) — the
+way DL4J's ``OpProfiler``/``PerformanceListener`` attributed JVM workloads,
+but against the compiled XLA step instead of per-op dispatch:
+
+- **ground truth**: :func:`xla_step_cost` runs XLA's ``cost_analysis()`` /
+  ``memory_analysis()`` on the compiled fused train step — total flops,
+  bytes accessed, and the argument/output/temp byte split of the executable;
+- **attribution**: :func:`layer_costs` walks a MultiLayerNetwork /
+  ComputationGraph conf (``Layer.flops_per_example`` — the same 2·MAC
+  accounting XLA uses for dots/convs) into per-layer rows of (flops,
+  param bytes, activation bytes); ``models.transformer.layer_costs`` does
+  the same for the functional transformer. :func:`cost_table` joins the two
+  into a percentage table whose ``coverage`` says how much of the compiled
+  step the per-layer estimate accounts for (the acceptance gate is ≥90%);
+- **HBM breakdown**: :func:`live_hbm_breakdown` buckets ``jax.live_arrays()``
+  by identity against the model's params / optimizer state / bn state (the
+  ``DeviceMemoryWatchdog.live_buffer_summary`` dump, made attributable) so
+  "HBM is full" decomposes into params vs opt state vs activations/other.
+
+Everything is host-side arithmetic over confs and compiled-executable
+metadata — no metric here syncs a device value.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .registry import MetricsRegistry, get_registry
+
+log = logging.getLogger(__name__)
+
+#: train-step flops ≈ forward + backward; backward of a matmul/conv is two
+#: same-shaped contractions (dX and dW), hence the textbook 3× forward
+TRAIN_FLOPS_FACTOR = 3.0
+#: paramless layers (pooling, activations) only back-propagate dX
+PARAMLESS_TRAIN_FACTOR = 2.0
+
+
+def cost_metrics(registry: Optional[MetricsRegistry] = None):
+    """Get-or-create the cost-observatory gauge families (one declaration
+    site so bench.py, tests and docs agree on names + labels)."""
+    r = registry or get_registry()
+    return {
+        "flops": r.gauge(
+            "tdl_model_flops_per_step",
+            "Floating-point ops of one train step (XLA cost_analysis when "
+            "measured, else the per-layer estimate)", labels=("model",)),
+        "peak": r.gauge(
+            "tdl_hbm_peak_bytes",
+            "Peak device bytes of one compiled step: arguments + outputs + "
+            "XLA temp allocations, donated aliases counted once",
+            labels=("model",)),
+        "layer": r.gauge(
+            "tdl_layer_cost_info",
+            "Estimated train-step flops attributed to one layer",
+            labels=("model", "layer", "kind")),
+        "hbm": r.gauge(
+            "tdl_hbm_bytes",
+            "Live device bytes bucketed by what holds them "
+            "(params / opt_state / bn_state / other)",
+            labels=("model", "kind")),
+    }
+
+
+# ------------------------------------------------------------ layer estimate
+
+
+def _act_numel(out_type) -> float:
+    n = float(out_type.flat_size())
+    if out_type.kind == "rnn":
+        n *= float(out_type.timeseries_length or 1)
+    return n
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return int(sum(getattr(l, "nbytes", 0) for l in jax.tree.leaves(tree)))
+
+
+def _row(name: str, kind: str, fwd_flops: float, batch: int, train: bool,
+         has_params: bool, param_bytes: int, act_numel: float,
+         dtype_bytes: int) -> dict:
+    factor = 1.0
+    if train:
+        factor = TRAIN_FLOPS_FACTOR if has_params else PARAMLESS_TRAIN_FACTOR
+    return {
+        "layer": name,
+        "kind": kind,
+        "flops": float(fwd_flops) * batch * factor,
+        "param_bytes": int(param_bytes),
+        "activation_bytes": int(act_numel * batch * dtype_bytes),
+    }
+
+
+def layer_costs(net, batch: int, train: bool = True) -> List[dict]:
+    """Per-layer cost rows for a MultiLayerNetwork or ComputationGraph:
+    ``{layer, kind, flops, param_bytes, activation_bytes}`` per layer/node,
+    flops for ONE train (or inference) step at the given batch size."""
+    dtype_bytes = int(np.dtype(np.float32).itemsize)
+    try:
+        dtype_bytes = int(np.dtype(net._dtype).itemsize)
+    except Exception:
+        log.debug("unknown net dtype; assuming 4-byte activations")
+    conf = net.conf
+    rows: List[dict] = []
+    if hasattr(conf, "nodes"):  # ComputationGraph
+        types = conf.infer_types()
+        for name in conf.topo_order():
+            node = conf.nodes[name]
+            ins = [types[i] for i in node.inputs]
+            it = ins[0] if ins else None
+            if node.preprocessor is not None and it is not None:
+                it = node.preprocessor.output_type(it)
+            out = types[name]
+            if node.layer is not None:
+                fwd = node.layer.flops_per_example(it)
+                kind = type(node.layer).__name__
+                has_params = node.layer.has_params()
+            else:  # vertices are elementwise over their output
+                fwd = _act_numel(out)
+                kind = type(node.vertex).__name__
+                has_params = False
+            rows.append(_row(name, kind, fwd, batch, train, has_params,
+                             _tree_bytes(net.params_.get(name, {})),
+                             _act_numel(out), dtype_bytes))
+        return rows
+    for i, layer in enumerate(conf.layers):  # MultiLayerNetwork
+        it = net._input_types[i]
+        rows.append(_row(
+            f"{i}:{type(layer).__name__}", type(layer).__name__,
+            layer.flops_per_example(it), batch, train, layer.has_params(),
+            _tree_bytes(net.params_.get(str(i), {})),
+            _act_numel(layer.output_type(it)), dtype_bytes))
+    return rows
+
+
+def cost_table(rows: List[dict], xla: Optional[dict] = None) -> dict:
+    """Percentage table over per-layer rows, optionally joined against the
+    compiled step's XLA totals. ``coverage`` = estimated total / XLA total —
+    how much of the real executable the attribution accounts for."""
+    total = sum(r["flops"] for r in rows)
+    table = {
+        "layers": [{**r, "pct": round(100.0 * r["flops"] / total, 2)
+                    if total else 0.0} for r in rows],
+        "total_flops": total,
+        "param_bytes": sum(r["param_bytes"] for r in rows),
+        "activation_bytes": sum(r["activation_bytes"] for r in rows),
+    }
+    if xla is not None:
+        table["xla"] = xla
+        if xla.get("flops"):
+            table["coverage"] = round(total / xla["flops"], 4)
+    return table
+
+
+# --------------------------------------------------------------- XLA ground
+
+
+def xla_step_cost(fn, *args, **kwargs) -> dict:
+    """``cost_analysis()`` + ``memory_analysis()`` of the compiled ``fn``
+    (a ``jax.jit`` result, or any callable — jitted here) at the given
+    example arguments. Purely AOT: nothing executes on device."""
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # one entry per partition pre-0.5 jax
+        ca = ca[0] if ca else {}
+    out = {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "transcendentals": float(ca.get("transcendentals", 0.0)),
+    }
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(getattr(ma, "argument_size_in_bytes", 0))
+        outb = int(getattr(ma, "output_size_in_bytes", 0))
+        tmp = int(getattr(ma, "temp_size_in_bytes", 0))
+        alias = int(getattr(ma, "alias_size_in_bytes", 0))
+        out.update(argument_bytes=arg, output_bytes=outb, temp_bytes=tmp,
+                   alias_bytes=alias,
+                   # donated buffers alias an argument: count them once
+                   peak_bytes=max(0, arg + outb + tmp - alias))
+    except Exception:  # backends without memory stats still give flops
+        log.debug("memory_analysis unavailable on this backend", exc_info=True)
+    return out
+
+
+# ------------------------------------------------------------- HBM breakdown
+
+
+def live_hbm_breakdown(state_trees: Dict[str, Any], model: str = "model",
+                       registry: Optional[MetricsRegistry] = None) -> Dict[str, int]:
+    """Bucket every live device buffer by WHAT holds it: each named tree in
+    ``state_trees`` (e.g. ``{"params": ..., "opt_state": ...}``) claims its
+    leaves by object identity; everything else live on the devices lands in
+    ``"other"`` (staged batches, donated intermediates, other models). This
+    is ``DeviceMemoryWatchdog.live_buffer_summary`` made attributable —
+    published as ``tdl_hbm_bytes{model,kind}``."""
+    import jax
+
+    owner: Dict[int, str] = {}
+    for kind, tree in state_trees.items():
+        for leaf in jax.tree.leaves(tree):
+            owner[id(leaf)] = kind
+    out: Dict[str, int] = {k: 0 for k in state_trees}
+    out["other"] = 0
+    for a in jax.live_arrays():
+        try:
+            out[owner.get(id(a), "other")] += int(a.nbytes)
+        except Exception:
+            continue
+    gauge = cost_metrics(registry)["hbm"]
+    for kind, b in out.items():
+        gauge.labels(model, kind).set(b)
+    return out
+
+
+def net_hbm_breakdown(net, model: str = "model",
+                      registry: Optional[MetricsRegistry] = None) -> Dict[str, int]:
+    """:func:`live_hbm_breakdown` over a network's params / optimizer state /
+    bn state trees."""
+    return live_hbm_breakdown(
+        {"params": net.params_, "opt_state": net.updater_state,
+         "bn_state": getattr(net, "bn_state", {})},
+        model=model, registry=registry)
+
+
+# ------------------------------------------------------------------ publish
+
+
+def publish(model: str, rows: List[dict], xla: Optional[dict] = None,
+            registry: Optional[MetricsRegistry] = None) -> dict:
+    """Export one model's cost attribution as gauges and return the joined
+    :func:`cost_table`. ``tdl_model_flops_per_step`` carries the XLA-measured
+    total when available (the estimate otherwise); ``tdl_layer_cost_info``
+    carries the per-layer estimates the table is built from."""
+    m = cost_metrics(registry)
+    table = cost_table(rows, xla)
+    m["flops"].labels(model).set(
+        (xla or {}).get("flops") or table["total_flops"])
+    if (xla or {}).get("peak_bytes"):
+        m["peak"].labels(model).set(xla["peak_bytes"])
+    for r in rows:
+        m["layer"].labels(model, r["layer"], r["kind"]).set(r["flops"])
+    return table

@@ -24,9 +24,10 @@ import logging
 import os
 import threading
 import time
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from typing import Dict, List, Optional, Tuple
 
+from . import flight
 from .registry import MetricsRegistry, get_registry
 
 logger = logging.getLogger("deeplearning4j_tpu.monitoring")
@@ -244,33 +245,61 @@ def signature_of(*trees) -> Tuple:
     return tuple(sig)
 
 
-class RecompileWatchdog:
-    """Counts XLA compiles / compile seconds and warns on shape-churn.
+#: label for compiles no instrumented call site announced (warmup jits of
+#: helper functions, evaluation paths, third-party code)
+UNATTRIBUTED = "_unattributed"
 
-    Two correlated signals:
+#: pending signature→compile attributions older than this are stale (the
+#: noted call hit jax's executable cache and never compiled)
+ATTRIBUTION_WINDOW_S = 120.0
+
+
+class RecompileWatchdog:
+    """Counts XLA compiles / compile seconds — attributed per jitted
+    function — and warns on shape-churn.
+
+    Three correlated signals (ISSUE 10 layer 2):
 
     - every backend compile (via ``jax.monitoring``) increments
-      ``tdl_xla_compiles_total`` and adds to
-      ``tdl_xla_compile_seconds_total``;
-    - fit loops note their step-input signatures; when the same function
-      accumulates ≥ ``churn_threshold`` distinct signatures within
-      ``window_steps`` steps, a warning is logged and
-      ``tdl_shape_churn_warnings_total`` increments.
+      ``tdl_xla_compiles_total{fn}`` / ``tdl_xla_compile_seconds_total{fn}``.
+      Attribution: an instrumented fit loop calls :func:`note_signature`
+      immediately before dispatch; a NEW signature becomes that THREAD's
+      pending announcement, and the next backend-compile event on the same
+      thread claims it (compiles run synchronously on the dispatching
+      thread; an announcement whose call hit jax's executable cache is
+      overwritten by the thread's next one, never misattributed). Compiles
+      with no pending announcement land under ``fn="_unattributed"``. Each
+      also leaves a ``compile`` event (fn, signature, seconds) in the flight
+      recorder, so churn offenders appear in ``postmortem.json``;
+    - when the same function accumulates ≥ ``churn_threshold`` distinct
+      signatures within ``window_steps`` steps, a warning is logged and
+      ``tdl_shape_churn_warnings_total`` increments;
+    - the per-fn signature table is an LRU bounded at
+      ``max_signatures_per_fn`` (true shape churn would otherwise grow it
+      without bound on long runs); evictions are exported as
+      ``tdl_jit_signature_evictions_total{fn}`` instead of leaking memory.
 
     Use as a context manager (or ``install()``/``close()``); inactive
     instances cost nothing on the hot path.
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 window_steps: int = 50, churn_threshold: int = 3):
+                 window_steps: int = 50, churn_threshold: int = 3,
+                 max_signatures_per_fn: int = 512):
         self.registry = registry or get_registry()
         self.window_steps = max(1, window_steps)
         self.churn_threshold = max(2, churn_threshold)
+        self.max_signatures_per_fn = max(1, max_signatures_per_fn)
         r = self.registry
         self._compiles = r.counter(
-            "tdl_xla_compiles_total", "XLA backend compiles observed")
+            "tdl_xla_compiles_total",
+            "XLA backend compiles observed, attributed to the jitted "
+            "function whose new arg-shape signature triggered them",
+            labels=("fn",))
         self._compile_seconds = r.counter(
-            "tdl_xla_compile_seconds_total", "Seconds spent in XLA backend compiles")
+            "tdl_xla_compile_seconds_total",
+            "Seconds spent in XLA backend compiles, per attributed function",
+            labels=("fn",))
         self._churn = r.counter(
             "tdl_shape_churn_warnings_total",
             "Shape-churn warnings (same function compiled repeatedly)")
@@ -278,13 +307,27 @@ class RecompileWatchdog:
             "tdl_jit_new_signatures_total",
             "Distinct jit call signatures first seen, per function",
             labels=("fn",))
+        self._evictions = r.counter(
+            "tdl_jit_signature_evictions_total",
+            "Signatures evicted from the bounded per-fn LRU table (churn so "
+            "sustained the watchdog stopped remembering old shapes)",
+            labels=("fn",))
         self._lock = threading.Lock()
         self._step = 0
-        self._seen: Dict[str, set] = defaultdict(set)
+        self._seen: Dict[str, OrderedDict] = defaultdict(OrderedDict)  # LRU
         self._recent: Dict[str, deque] = defaultdict(deque)  # (step,) of new sigs
         self._warned_at: Dict[str, int] = {}
+        # per-THREAD latest unclaimed (fn, signature, noted_at): a compile
+        # runs synchronously on the thread that dispatched it, so claiming is
+        # thread-keyed — a stale announcement (new-to-us signature that hit
+        # jax's own executable cache, e.g. after an LRU eviction) is simply
+        # overwritten by that thread's next announcement instead of shifting
+        # a shared FIFO and misattributing every later compile
+        self._pending: Dict[int, Tuple[str, object, float]] = {}
         self.compile_count = 0
         self.compile_seconds = 0.0
+        self.per_fn_compiles: Dict[str, int] = defaultdict(int)
+        self.per_fn_compile_seconds: Dict[str, float] = defaultdict(float)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -308,21 +351,43 @@ class RecompileWatchdog:
     # -- signals -----------------------------------------------------------
 
     def _on_compile(self, duration: float) -> None:
+        now = time.monotonic()
         with self._lock:
             self.compile_count += 1
             self.compile_seconds += duration
-        self._compiles.inc()
-        self._compile_seconds.inc(duration)
+            fn, sig = UNATTRIBUTED, None
+            claimed = self._pending.pop(threading.get_ident(), None)
+            # staleness is judged at compile START (the event fires at the
+            # END and carries the duration): a bert-large compile can run
+            # longer than the window and must still be attributed
+            if (claimed is not None
+                    and now - duration - claimed[2] <= ATTRIBUTION_WINDOW_S):
+                fn, sig = claimed[0], claimed[1]
+            self.per_fn_compiles[fn] += 1
+            self.per_fn_compile_seconds[fn] += duration
+        self._compiles.labels(fn).inc()
+        self._compile_seconds.labels(fn).inc(duration)
+        # black-box breadcrumb: postmortems list churn offenders from these
+        flight.record("compile", fn=fn, seconds=round(duration, 4),
+                      signature=None if sig is None else repr(sig))
 
     def step(self) -> None:
         with self._lock:
             self._step += 1
 
     def note_signature(self, fn_name: str, signature) -> None:
+        evicted = 0
         with self._lock:
-            if signature in self._seen[fn_name]:
+            seen = self._seen[fn_name]
+            if signature in seen:
+                seen.move_to_end(signature)  # LRU touch
                 return
-            self._seen[fn_name].add(signature)
+            seen[signature] = None
+            while len(seen) > self.max_signatures_per_fn:
+                seen.popitem(last=False)
+                evicted += 1
+            self._pending[threading.get_ident()] = (
+                fn_name, signature, time.monotonic())
             step = self._step
             recent = self._recent[fn_name]
             recent.append(step)
@@ -335,6 +400,8 @@ class RecompileWatchdog:
             if should_warn:
                 self._warned_at[fn_name] = step
         self._sig_counter.labels(fn_name).inc()
+        if evicted:
+            self._evictions.labels(fn_name).inc(evicted)
         if should_warn:
             self._churn.inc()
             logger.warning(
@@ -352,4 +419,8 @@ class RecompileWatchdog:
                 "compile_seconds": self.compile_seconds,
                 "steps": self._step,
                 "signatures": {k: len(v) for k, v in self._seen.items()},
+                "per_fn_compiles": dict(self.per_fn_compiles),
+                "per_fn_compile_seconds": {
+                    k: round(v, 4)
+                    for k, v in self.per_fn_compile_seconds.items()},
             }

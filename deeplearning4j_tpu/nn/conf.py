@@ -91,6 +91,25 @@ def _conv_out(size, k, s, p, same):
     return (size + 2 * p - k) // s + 1
 
 
+def _conv_taps(in_size, k, s, p, d, same, out_size):
+    """Total kernel taps landing INSIDE the input along one spatial dim,
+    summed over output positions — XLA's cost_analysis counts conv flops
+    over valid taps only (padding positions multiply nothing), so the
+    per-layer estimate must too or SAME-padded stacks overcount ~15%."""
+    if same:  # lax SAME padding: pad_total so out = ceil(in/s)
+        pad_total = max((out_size - 1) * s + (k - 1) * d + 1 - in_size, 0)
+        pad_lo = pad_total // 2
+    else:
+        pad_lo = p
+    total = 0
+    for o in range(out_size):
+        start = o * s - pad_lo
+        for j in range(k):
+            if 0 <= start + j * d < in_size:
+                total += 1
+    return total
+
+
 # ---------------------------------------------------------------- param roles
 
 # Role vocabulary for parameter partitioning (parallel.partition.SpecLayout
@@ -201,6 +220,18 @@ class Layer:
         ``W`` is a table, not a projection) override."""
         return classify_param_tree(params)
 
+    def flops_per_example(self, it: InputType) -> float:
+        """Estimated FORWARD floating-point operations for ONE example
+        (monitoring.costmodel multiplies by batch and the train factor).
+        The default models a cheap elementwise layer: one op per output
+        element. Layers with real arithmetic (dense/conv/recurrent) override
+        with the textbook 2·MACs formulas, which is also how XLA's
+        ``cost_analysis()`` counts dots and convolutions — so the per-layer
+        table can be validated against the compiled step's total."""
+        out = self.output_type(it)
+        T = out.timeseries_length if out.kind == "rnn" else 1
+        return float(out.flat_size()) * float(T or 1)
+
     def _apply_dropout(self, x, training, rng):
         """DL4J conf .dropOut(...): a float (probability of RETAINING an
         activation, inverted scaling) or an IDropout scheme object
@@ -274,6 +305,12 @@ class DenseLayer(Layer):
         if self.has_bias:
             z = z + params["b"]
         return act.get(self.activation)(z)
+
+    def flops_per_example(self, it: InputType) -> float:
+        n_in = self.n_in or it.flat_size()
+        # time-distributed over [B,T,C] when the input kept its timeline
+        T = (it.timeseries_length or 1) if it.kind == "rnn" else 1
+        return float(T) * (2.0 * n_in * self.n_out + self.n_out)
 
 
 @dataclass
@@ -408,6 +445,19 @@ class ConvolutionLayer(Layer):
             z = z + params["b"]
         return _nchw(act.get(self.activation)(z))
 
+    def _spatial_taps(self, it: InputType) -> float:
+        out = self.output_type(it)
+        same = self.convolution_mode == "same"
+        th = _conv_taps(it.height, self.kernel_size[0], self.stride[0],
+                        self.padding[0], self.dilation[0], same, out.height)
+        tw = _conv_taps(it.width, self.kernel_size[1], self.stride[1],
+                        self.padding[1], self.dilation[1], same, out.width)
+        return float(th) * float(tw)
+
+    def flops_per_example(self, it: InputType) -> float:
+        c_in = self.n_in or it.channels
+        return 2.0 * self._spatial_taps(it) * self.n_out * c_in
+
 
 @dataclass
 class Deconvolution2D(ConvolutionLayer):
@@ -445,6 +495,12 @@ class Deconvolution2D(ConvolutionLayer):
         if self.has_bias:
             z = z + params["b"]
         return _nchw(act.get(self.activation)(z))
+
+    def flops_per_example(self, it: InputType) -> float:
+        # each input pixel scatters through the kernel into cout outputs
+        c_in = self.n_in or it.channels
+        kh, kw = self.kernel_size
+        return 2.0 * it.height * it.width * c_in * kh * kw * self.n_out
 
 
 @dataclass
@@ -485,6 +541,10 @@ class DepthwiseConvolution2D(ConvolutionLayer):
             z = z + params["b"]
         return _nchw(act.get(self.activation)(z))
 
+    def flops_per_example(self, it: InputType) -> float:
+        c_in = self.n_in or it.channels
+        return 2.0 * self._spatial_taps(it) * c_in * self.depth_multiplier
+
 
 @dataclass
 class SeparableConvolution2D(ConvolutionLayer):
@@ -521,6 +581,14 @@ class SeparableConvolution2D(ConvolutionLayer):
         if self.has_bias:
             z = z + params["b"]
         return _nchw(act.get(self.activation)(z))
+
+    def flops_per_example(self, it: InputType) -> float:
+        out = self.output_type(it)
+        c_in = self.n_in or it.channels
+        mid = c_in * self.depth_multiplier
+        depthwise = 2.0 * self._spatial_taps(it) * mid
+        pointwise = 2.0 * out.height * out.width * mid * self.n_out
+        return depthwise + pointwise
 
 
 @dataclass
@@ -563,6 +631,11 @@ class SubsamplingLayer(Layer):
             s = jax.lax.reduce_window(jnp.abs(x) ** p, 0.0, jax.lax.add, dims, strides, pad)
             return _nchw(s ** (1.0 / p))
         raise ValueError(f"unknown pooling {self.pooling_type}")
+
+    def flops_per_example(self, it: InputType) -> float:
+        out = self.output_type(it)
+        return (float(out.height * out.width * out.channels)
+                * self.kernel_size[0] * self.kernel_size[1])
 
 
 @dataclass
@@ -670,6 +743,11 @@ class BatchNormalization(Layer):
         out, _ = self.forward_bn(params, state or self.init_state(it, x.dtype), x, it, training=False)
         return out
 
+    def flops_per_example(self, it: InputType) -> float:
+        # one-pass moments (sum + sum-of-squares) + scale/offset apply
+        T = (it.timeseries_length or 1) if it.kind == "rnn" else 1
+        return 8.0 * it.flat_size() * float(T)
+
 
 @dataclass
 class LocalResponseNormalization(Layer):
@@ -728,6 +806,10 @@ class EmbeddingLayer(Layer):
         # W is the [vocab, n_out] lookup TABLE here, not a projection kernel
         return {k: (ROLE_EMBEDDING if k == "W" else param_role(k, v))
                 for k, v in params.items()}
+
+    def flops_per_example(self, it: InputType) -> float:
+        # a gather moves bytes, not flops — count only the bias/activation
+        return float(self.n_out)
 
 
 @dataclass
@@ -845,6 +927,17 @@ class LSTM(Layer):
         )
         return jnp.transpose(outs, (1, 2, 0)), hT, cT
 
+    def flops_per_example(self, it: InputType) -> float:
+        n_in = self.n_in or it.size
+        H = self.n_out
+        T = float(it.timeseries_length or 1)
+        # input + recurrent projections into 4 gates, plus ~10 elementwise
+        # ops/unit for the gate math (peepholes add 3 multiply-adds)
+        per_step = 2.0 * n_in * 4 * H + 2.0 * H * 4 * H + 10.0 * H
+        if self.peephole:
+            per_step += 6.0 * H
+        return T * per_step
+
 
 @dataclass
 class GravesLSTM(LSTM):
@@ -886,6 +979,12 @@ class SimpleRnn(Layer):
         _, outs = jax.lax.scan(step, h0, xz)
         return jnp.transpose(outs, (1, 2, 0))
 
+    def flops_per_example(self, it: InputType) -> float:
+        n_in = self.n_in or it.size
+        H = self.n_out
+        T = float(it.timeseries_length or 1)
+        return T * (2.0 * n_in * H + 2.0 * H * H + 2.0 * H)
+
 
 @dataclass
 class Bidirectional(Layer):
@@ -923,6 +1022,9 @@ class Bidirectional(Layer):
         d["fwd"] = self.fwd.to_json()
         return d
 
+    def flops_per_example(self, it: InputType) -> float:
+        return 2.0 * self.fwd.flops_per_example(it)
+
 
 @dataclass
 class LastTimeStep(Layer):
@@ -946,6 +1048,10 @@ class LastTimeStep(Layer):
             idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=-1) - 1, 0)
             return jnp.take_along_axis(x, idx[:, None, None], axis=2)[:, :, 0]
         return x[:, :, -1]
+
+    def flops_per_example(self, it: InputType) -> float:
+        return (self.underlying.flops_per_example(it)
+                if self.underlying is not None else 0.0)
 
 
 @dataclass

@@ -78,6 +78,24 @@ class GangEvent:
     detail: str = ""
 
 
+def _compile_churn(events: Sequence[dict]) -> List[dict]:
+    """Per-(proc, fn) compile count + seconds from merged ``compile`` flight
+    events, worst offender first — the postmortem's answer to "who kept
+    recompiling" (ROADMAP 4's executable cache targets exactly these rows)."""
+    agg: Dict[Tuple[str, str], Dict[str, float]] = {}
+    for e in events:
+        if e.get("kind") != "compile":
+            continue
+        key = (str(e.get("proc", "?")), str(e.get("fn", "?")))
+        row = agg.setdefault(key, {"compiles": 0, "seconds": 0.0})
+        row["compiles"] += 1
+        row["seconds"] += float(e.get("seconds") or 0.0)
+    return [{"proc": proc, "fn": fn, "compiles": row["compiles"],
+             "seconds": round(row["seconds"], 4)}
+            for (proc, fn), row in sorted(
+                agg.items(), key=lambda kv: -kv[1]["compiles"])]
+
+
 def _supervisor_metrics(registry: MetricsRegistry):
     return (
         registry.counter("tdl_worker_deaths_total",
@@ -435,6 +453,10 @@ class GangSupervisor:
             "detail": failure.detail,
             "written_wall": time.time(),  # wallclock-ok: report timestamp for humans
             "procs": sorted({e.get("proc", "?") for e in events}),
+            # compile-churn offenders (ISSUE 10): per-(proc, fn) compile
+            # count + seconds from the RecompileWatchdog's `compile` events,
+            # worst first — "which function kept recompiling before we died"
+            "compile_churn": _compile_churn(events),
             "events": events,
         }
         tmp = self.postmortem_path + ".tmp"

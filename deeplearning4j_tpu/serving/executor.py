@@ -67,11 +67,13 @@ class InferenceFuture:
     """
 
     __slots__ = ("x", "deadline", "enqueued_at", "result", "error", "_done",
-                 "abandoned", "_lock")
+                 "abandoned", "_lock", "request_id")
 
-    def __init__(self, x: np.ndarray, deadline: Optional[float]):
+    def __init__(self, x: np.ndarray, deadline: Optional[float],
+                 request_id: Optional[str] = None):
         self.x = x
         self.deadline = deadline
+        self.request_id = request_id
         self.enqueued_at = time.monotonic()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -202,22 +204,29 @@ class BatchingInferenceExecutor:
 
     # -- admission ---------------------------------------------------------
 
-    def submit(self, x, deadline_ms: Optional[float] = None) -> InferenceFuture:
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               request_id: Optional[str] = None) -> InferenceFuture:
         """Admit one request. Raises :class:`QueueFullError` at capacity,
         :class:`ExecutorClosedError` when stopped/draining, ``ValueError``
-        on inputs with no batch dimension."""
+        on inputs with no batch dimension. ``request_id`` (the server's
+        ``X-Request-Id``) rides the future into every executor log line."""
         arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
         if arr.ndim == 0:
             raise ValueError("inference input must have a batch dimension; "
                              "got a scalar")
         ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
         deadline = time.monotonic() + ms / 1000.0 if ms is not None else None
-        fut = InferenceFuture(arr, deadline)
+        fut = InferenceFuture(arr, deadline, request_id=request_id)
         with self._cv:
             if not self._accepting:
                 raise ExecutorClosedError("executor is not accepting requests")
             if len(self._q) >= self.max_queue:
                 self._m.shed.labels(reason="queue_full").inc()
+                # debug, not warning: queue-full is the EXPECTED overload
+                # behavior (thousands/sec under stress), and logging under
+                # the admission lock would serialize contended submitters
+                log.debug("request %s: admission queue full (%d queued)",
+                          request_id, self.max_queue)
                 raise QueueFullError(
                     f"admission queue full ({self.max_queue} queued)")
             self._q.append(fut)
@@ -272,12 +281,23 @@ class BatchingInferenceExecutor:
                 # request was already counted by its waiter (reason=deadline)
                 if req._expire(DeadlineExceededError(
                         "deadline expired while queued")):
+                    # the abandoned case already logged server-side; and like
+                    # queue_full above this is the EXPECTED overload path —
+                    # debug, so the single batch-pump thread never stalls on
+                    # per-request log IO exactly when it is most loaded
                     self._m.shed.labels(reason="queue_expired").inc()
+                    log.debug("request %s: expired in queue after %.3fs "
+                              "(deadline passed before inference started)",
+                              req.request_id, now - req.enqueued_at)
             else:
                 live.append(req)
         if not live:
             return
         self._m.batch_size.observe(sum(r.x.shape[0] for r in live))
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug("inference batch: %d rows from requests [%s]",
+                      sum(r.x.shape[0] for r in live),
+                      ", ".join(str(r.request_id) for r in live))
         groups: Dict[Tuple[str, tuple], List[InferenceFuture]] = {}
         for req in live:
             groups.setdefault((str(req.x.dtype), req.x.shape[1:]), []).append(req)
@@ -286,6 +306,9 @@ class BatchingInferenceExecutor:
                 fault_point("infer")
                 outs = self._run([r.x for r in reqs])
             except Exception as e:  # model failure → every rider sees it
+                log.warning("inference failed for requests [%s]: %s: %s",
+                            ", ".join(str(r.request_id) for r in reqs),
+                            type(e).__name__, e)
                 for r in reqs:
                     r._resolve(error=e)
                 continue

@@ -53,6 +53,23 @@ DEFAULT_MAX_BODY_BYTES = 16 << 20
 #: delta-seconds hint sent with 429/503 (RFC 7231 integer seconds)
 RETRY_AFTER_S = 1
 
+#: accepted client-supplied X-Request-Id chars/length; anything else is
+#: replaced with a server-generated id (a log-injection-safe correlation key)
+_REQUEST_ID_MAX = 128
+
+
+def _request_id(header_value: Optional[str]) -> str:
+    """The request's correlation id: the client's ``X-Request-Id`` when it is
+    printable/sane, else a fresh one — echoed on EVERY response (including
+    error JSON) and attached to executor log lines, so a client-reported
+    slow request can be found in server telemetry."""
+    import uuid
+
+    rid = (header_value or "").strip()
+    if rid and len(rid) <= _REQUEST_ID_MAX and rid.isprintable():
+        return rid
+    return uuid.uuid4().hex[:16]
+
 
 class JsonModelServer:
     def __init__(self, model, port: int = 0,
@@ -181,8 +198,11 @@ class JsonModelServer:
         except OSError:
             log.debug("client stalled while its oversized body was drained")
 
-    def _handle_predict(self, handler) -> Tuple[int, dict, Optional[int]]:
+    def _handle_predict(self, handler,
+                        rid: Optional[str] = None) -> Tuple[int, dict, Optional[int]]:
         """Returns (status, json body, Retry-After seconds or None)."""
+        rid = rid if rid is not None else _request_id(
+            handler.headers.get("X-Request-Id"))
         content_length = handler.headers.get("Content-Length")
         try:
             length = int(content_length)
@@ -226,7 +246,7 @@ class JsonModelServer:
         except Exception as e:
             return 400, {"error": f"{type(e).__name__}: {e}"}, None
         try:
-            fut = executor.submit(x, deadline_ms=deadline_ms)
+            fut = executor.submit(x, deadline_ms=deadline_ms, request_id=rid)
         except QueueFullError as e:
             return 429, {"error": str(e)}, RETRY_AFTER_S
         except ExecutorClosedError as e:
@@ -241,6 +261,8 @@ class JsonModelServer:
             # claims the shed accounting so the executor won't also count
             # this request when it later pops it expired
             self._m.shed.labels(reason="deadline").inc()
+            log.warning("request %s: deadline exceeded while inference "
+                        "still pending", rid)
             return 504, {"error": "deadline exceeded before inference "
                                   "completed"}, None
         if fut.error is not None:
@@ -283,13 +305,15 @@ class JsonModelServer:
             def log_message(self, *args):
                 pass
 
-            def _json(self, obj, code=200, retry_after=None):
+            def _json(self, obj, code=200, retry_after=None, request_id=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if retry_after is not None:
                     self.send_header("Retry-After", str(retry_after))
+                if request_id is not None:
+                    self.send_header("X-Request-Id", request_id)
                 self.end_headers()
                 try:
                     self.wfile.write(body)
@@ -301,8 +325,13 @@ class JsonModelServer:
                     server._inflight += 1
                 try:
                     t0 = time.perf_counter()
-                    code, obj, retry_after = server._handle_predict(self)
-                    self._json(obj, code, retry_after)
+                    # the correlation id rides every response — header AND
+                    # body (incl. 429/504/413 error JSON), so a client-
+                    # reported slow request is greppable in server telemetry
+                    rid = _request_id(self.headers.get("X-Request-Id"))
+                    code, obj, retry_after = server._handle_predict(self, rid)
+                    obj.setdefault("request_id", rid)
+                    self._json(obj, code, retry_after, request_id=rid)
                     server._m.requests.labels(code=str(code)).inc()
                     server._m.latency.observe(time.perf_counter() - t0)
                 finally:
@@ -328,6 +357,11 @@ class JsonModelServer:
             # rebind the same port during TIME_WAIT after a restart
             allow_reuse_address = True
             daemon_threads = True
+            # http.server's default listen backlog is 5: a 32-client
+            # connect burst overflows it and the kernel RSTs the excess —
+            # clients then see resets mid-request under load that the
+            # admission queue was supposed to absorb as clean 429s
+            request_queue_size = 128
 
         self._httpd = _Httpd(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]
@@ -427,6 +461,7 @@ class JsonModelClient:
     # -- request -----------------------------------------------------------
 
     def predict(self, data, deadline_ms: Optional[float] = None) -> Any:
+        import http.client
         import urllib.error
         import urllib.request
 
@@ -461,6 +496,13 @@ class JsonModelClient:
                 retry_after = e.headers.get("Retry-After") if e.headers else None
             except urllib.error.URLError as e:
                 last_msg = f"cannot reach {self.url}: {e.reason}"
+            except (OSError, http.client.HTTPException, ValueError) as e:
+                # a reset/truncation MID-RESPONSE (connection reset while
+                # reading the body, RemoteDisconnected, torn JSON) is a
+                # connection error like any other: the documented contract
+                # retries it, it must not escape as a raw ConnectionResetError
+                last_msg = (f"connection error to {self.url}: "
+                            f"{type(e).__name__}: {e}")
             self._record_failure()
             if attempt >= self.retries:
                 break

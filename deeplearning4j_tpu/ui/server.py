@@ -142,6 +142,7 @@ class _Handler(BaseHTTPRequestHandler):
     registry = None  # MetricsRegistry; None = the process default
     spool_dir = None  # metrics-spool dir → /metrics merges at scrape time
     spool_local_proc = "local"  # proc label for THIS process's registry
+    alert_engine = None  # AlertEngine → /alerts evaluates at request time
 
     def log_message(self, *args):
         pass
@@ -200,6 +201,19 @@ class _Handler(BaseHTTPRequestHandler):
                     self.spool_dir, local_registry=self._registry()))
             else:
                 self._json(self._registry().snapshot())
+            return
+        if self.path == "/alerts":
+            # SLO alert engine (ISSUE 10): rules evaluate at request time
+            # over the same registry/spool view /metrics serves; firing
+            # rules also land in the flight recorder for postmortems
+            engine = self.alert_engine
+            if engine is None:
+                self._json({"error": "no alert engine attached — "
+                                     "UIServer.attach_alerts(engine)"}, 404)
+                return
+            alerts = engine.evaluate()
+            self._json({"alerts": alerts,
+                        "firing": [a["rule"] for a in alerts if a["firing"]]})
             return
         if self.path == "/sessions":
             self._json(self.storage.session_ids())
@@ -448,6 +462,23 @@ class UIServer:
         self._httpd.RequestHandlerClass.spool_local_proc = local_proc
 
     attachSpoolDir = attach_spool_dir
+
+    def attach_alerts(self, engine=None) -> None:
+        """Serve the SLO alert engine at ``/alerts`` (ISSUE 10): rules
+        evaluate on every request over this server's registry + spool view.
+        With no ``engine``, a default one (``alerts.default_rules()``) is
+        built over whatever registry/spool dir is currently attached."""
+        if self._httpd is None:
+            self._start(self._storages[0] if self._storages else StatsStorage())
+        if engine is None:
+            from ..monitoring.alerts import AlertEngine
+
+            handler = self._httpd.RequestHandlerClass
+            engine = AlertEngine(registry=handler.registry,
+                                 spool_dir=handler.spool_dir)
+        self._httpd.RequestHandlerClass.alert_engine = engine
+
+    attachAlerts = attach_alerts
 
     def attach_model(self, net) -> None:
         """Populate the model tab (C14 model-graph tier): /train/model and

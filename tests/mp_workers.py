@@ -263,6 +263,67 @@ def observability_train():
     _write(rank, {"iterations": int(net.iteration), "rank": rank})
 
 
+def churn_train():
+    """ISSUE 10 acceptance target: a 2-rank gang whose FIRST incarnation
+    deliberately churns minibatch shapes after marking warmup done — the
+    RecompileWatchdog attributes the recompiles per fn, the AlertEngine's
+    ``recompiles_after_warmup`` rule fires (alert + compile events land in
+    the flight ring), and a crash injected later (TDL_FAULT_SPEC) makes the
+    supervisor write a postmortem carrying both. The respawned incarnation
+    trains steady-shape to completion, proving compiles stay FLAT after
+    warmup when shapes don't churn."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.monitoring import (AlertEngine, RecompileWatchdog,
+                                               aggregate, flight)
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    col = ProcessCollectives()
+    rank = col.rank
+    incarnation = int(os.environ.get("TDL_GANG_RESTART_COUNT", "0"))
+    net = _toy_net(seed=7 + rank)
+    mesh = Mesh(np.array(jax.local_devices()[:1]).reshape(1), ("data",))
+    trainer = ParallelTrainer(net, mesh)
+    wd = RecompileWatchdog().install()
+    engine = AlertEngine()
+
+    def fit(step, n=16):
+        x, y = _global_batch(step, n=n)
+        trainer.fit([DataSet(x, y)])
+
+    for step in range(4):  # steady warmup: one signature, one compile
+        fit(step)
+    engine.mark_warmup_done()
+    compiles_at_warmup = dict(wd.stats()["per_fn_compiles"])
+    steady_eval = [a for a in engine.evaluate()
+                   if a["rule"] == "recompiles_after_warmup"][0]
+    churn_firing = False
+    if incarnation == 0:
+        for step, n in enumerate((6, 7, 9, 11), start=4):  # shape churn
+            fit(step, n=n)
+        churn_firing = [a for a in engine.evaluate()
+                        if a["rule"] == "recompiles_after_warmup"][0]["firing"]
+        for step in range(8, 14):  # crash@iter=10,rank=1 fires in here
+            fit(step)
+    else:
+        for step in range(4, 14):  # steady to completion
+            fit(step)
+    final_compiles = dict(wd.stats()["per_fn_compiles"])
+    wd.close()
+    aggregate.maybe_spool(force=True)
+    flight.flush()
+    col.barrier("churn-done")
+    _write(rank, {"rank": rank, "incarnation": incarnation,
+                  "steady_firing": steady_eval["firing"],
+                  "churn_firing": churn_firing,
+                  "per_fn_compiles_warmup": compiles_at_warmup,
+                  "per_fn_compiles_final": final_compiles})
+
+
 def etl_train():
     """ISSUE 6 acceptance target: per-rank SHARDED multi-process ETL feeding
     a 2-rank data-parallel gang under GangSupervisor. Each rank's ETL

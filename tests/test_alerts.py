@@ -1,0 +1,419 @@
+"""SLO alert engine (ISSUE 10 tentpole, layer 3) + compile attribution.
+
+Fast tier: rule evaluation semantics (agg/ratio/after_warmup/no_data),
+edge-triggered flight events + counters, the /alerts endpoint, the
+compile-attribution acceptance ("flat after warmup per fn; churn fires the
+recompile alert in /alerts"), and the alert-rule AST lint (rules may only
+reference registry-declared or derived metric families).
+
+Slow tier: the full gang acceptance — a shape-churning, crash-injected gang
+under GangSupervisor leaves a postmortem whose event stream carries the
+fired alert and the compile events, aggregated into `compile_churn`.
+"""
+
+import ast
+import json
+import os
+import pathlib
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitoring import (AlertEngine, AlertRule,
+                                           MetricsRegistry, RecompileWatchdog,
+                                           default_rules)
+from deeplearning4j_tpu.monitoring import aggregate, flight
+from deeplearning4j_tpu.monitoring.aggregate import MetricsSpooler
+from deeplearning4j_tpu.monitoring.flight import FlightRecorder
+
+WORKERS = os.path.join(os.path.dirname(__file__), "mp_workers.py")
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _net():
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(n=16):
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    rs = np.random.RandomState(0)
+    return DataSet(rs.randn(n, 4).astype(np.float32),
+                   np.eye(2, dtype=np.float32)[rs.randint(0, 2, n)])
+
+
+# ----------------------------------------------------------- rule semantics
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError, match="unknown op"):
+        AlertRule("x", "tdl_score", op="!=")
+    with pytest.raises(ValueError, match="unknown agg"):
+        AlertRule("x", "tdl_score", agg="p99")
+    with pytest.raises(ValueError, match="duplicate"):
+        AlertEngine(rules=(AlertRule("dup", "tdl_score"),
+                           AlertRule("dup", "tdl_score")))
+
+
+def test_threshold_and_agg_over_series():
+    reg = MetricsRegistry()
+    g = reg.gauge("tdl_inference_queue_depth", labels=("replica",))
+    g.labels("a").set(10)
+    g.labels("b").set(55)
+    eng = AlertEngine(rules=(
+        AlertRule("hwm_max", "tdl_inference_queue_depth", ">=", 48, agg="max"),
+        AlertRule("hwm_min", "tdl_inference_queue_depth", ">=", 48, agg="min"),
+        AlertRule("hwm_sum", "tdl_inference_queue_depth", ">", 60, agg="sum"),
+    ), registry=reg)
+    by = {a["rule"]: a for a in eng.evaluate()}
+    assert by["hwm_max"]["firing"] and by["hwm_max"]["value"] == 55
+    assert not by["hwm_min"]["firing"]
+    assert by["hwm_sum"]["firing"] and by["hwm_sum"]["value"] == 65
+
+
+def test_histogram_agg_mean_and_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("tdl_input_wait_seconds", buckets=(0.1, 1.0))
+    for v in (0.2, 0.4, 0.6):
+        h.observe(v)
+    eng = AlertEngine(rules=(
+        AlertRule("mean_wait", "tdl_input_wait_seconds", ">", 0.3, agg="mean"),
+        AlertRule("n_waits", "tdl_input_wait_seconds", ">", 2, agg="sum"),
+    ), registry=reg)
+    by = {a["rule"]: a for a in eng.evaluate()}
+    assert by["mean_wait"]["value"] == pytest.approx(0.4)
+    assert by["mean_wait"]["firing"]
+    assert by["n_waits"]["value"] == 3  # histograms under sum read the count
+
+
+def test_ratio_rule_and_no_data():
+    reg = MetricsRegistry()
+    eng = AlertEngine(rules=(
+        AlertRule("hbm", "tdl_device_memory_bytes_in_use", ">", 0.9,
+                  ratio_of="tdl_device_memory_limit_bytes"),
+    ), registry=reg)
+    assert eng.evaluate()[0]["state"] == "no_data"  # neither family exists
+    reg.gauge("tdl_device_memory_bytes_in_use", labels=("device",)) \
+       .labels("d0").set(95)
+    assert eng.evaluate()[0]["state"] == "no_data"  # no limit → no ratio
+    reg.gauge("tdl_device_memory_limit_bytes", labels=("device",)) \
+       .labels("d0").set(100)
+    row = eng.evaluate()[0]
+    assert row["firing"] and row["value"] == pytest.approx(0.95)
+
+
+def test_ratio_rule_pairs_series_by_labels_not_global_aggregates():
+    """A huge denominator on ONE proc/device must not hide another device
+    sitting at 97% of ITS OWN limit — ratios are per-series, agg folds the
+    ratios."""
+    tpu = MetricsRegistry()
+    tpu.gauge("tdl_device_memory_bytes_in_use", labels=("device",)) \
+       .labels("tpu:0").set(15.5e9)
+    tpu.gauge("tdl_device_memory_limit_bytes", labels=("device",)) \
+       .labels("tpu:0").set(16e9)
+    host = MetricsRegistry()
+    host.gauge("tdl_device_memory_bytes_in_use", labels=("device",)) \
+        .labels("host").set(2e9)
+    host.gauge("tdl_device_memory_limit_bytes", labels=("device",)) \
+        .labels("host").set(64e9)
+    rule = AlertRule("hbm", "tdl_device_memory_bytes_in_use", ">", 0.9,
+                     ratio_of="tdl_device_memory_limit_bytes")
+    eng = AlertEngine(rules=(rule,), registry=tpu)
+    # hand the engine both snapshots the way a spool merge would
+    snaps = [tpu.snapshot(), host.snapshot()]
+    value, state = eng._rule_value(snaps, rule)
+    assert state == "ok" and value == pytest.approx(15.5 / 16)
+    # a series with no same-labels denominator is skipped, not mis-paired
+    lone = MetricsRegistry()
+    lone.gauge("tdl_device_memory_bytes_in_use", labels=("device",)) \
+        .labels("tpu:1").set(1e9)
+    assert eng._rule_value([lone.snapshot()], rule) == (None, "no_data")
+
+
+def test_after_warmup_rule_measures_increase_only():
+    reg = MetricsRegistry()
+    c = reg.counter("tdl_input_starved_steps_total")
+    c.inc(7)  # starvation during warmup is expected
+    eng = AlertEngine(rules=(
+        AlertRule("starved", "tdl_input_starved_steps_total", ">", 0,
+                  agg="sum", after_warmup=True),), registry=reg)
+    assert eng.evaluate()[0]["state"] == "pending_warmup"
+    eng.mark_warmup_done()
+    row = eng.evaluate()[0]
+    assert row["value"] == 0.0 and not row["firing"]
+    c.inc(2)
+    row = eng.evaluate()[0]
+    assert row["firing"] and row["value"] == 2.0
+
+
+def test_rising_edge_records_flight_event_and_counter_once():
+    rec = FlightRecorder(proc="alert-test")
+    flight.set_flight_recorder(rec)
+    try:
+        reg = MetricsRegistry()
+        g = reg.gauge("tdl_inference_queue_depth")
+        eng = AlertEngine(rules=(
+            AlertRule("hwm", "tdl_inference_queue_depth", ">=", 48),),
+            registry=reg)
+        g.set(60)
+        eng.evaluate()
+        eng.evaluate()  # still firing: level stays, NO second edge
+        g.set(0)
+        eng.evaluate()  # clears
+        g.set(70)
+        eng.evaluate()  # second rising edge
+        fired = reg.get("tdl_alerts_fired_total").labels("hwm").value
+        assert fired == 2
+        events = [e for e in rec.events() if e["kind"] == "alert"]
+        assert len(events) == 2
+        assert events[0]["rule"] == "hwm" and events[0]["value"] == 60
+        assert reg.get("tdl_alert_firing").labels("hwm").value == 1
+    finally:
+        flight.set_flight_recorder(None)
+
+
+def test_engine_over_spool_dir_sees_derived_straggler_gauges(tmp_path):
+    def rank_registry(step_seconds):
+        reg = MetricsRegistry()
+        h = reg.histogram("tdl_step_wall_seconds", labels=("trainer",))
+        for _ in range(5):
+            h.labels("T").observe(step_seconds)
+        return reg
+
+    MetricsSpooler(str(tmp_path), proc="rank0", registry=rank_registry(0.01),
+                   interval=0.0, rank=0).spool(force=True)
+    MetricsSpooler(str(tmp_path), proc="rank1", registry=rank_registry(0.05),
+                   interval=0.0, rank=1).spool(force=True)
+    eng = AlertEngine(registry=MetricsRegistry(), spool_dir=str(tmp_path))
+    by = {a["rule"]: a for a in eng.evaluate()}
+    skew = by["straggler_skew"]
+    assert skew["firing"] and skew["value"] == pytest.approx(5.0)
+
+
+# ----------------------------- compile attribution acceptance (fast tier)
+
+
+def test_compiles_flat_after_warmup_and_churn_fires_alert_in_alerts_endpoint():
+    """ISSUE 10 acceptance (in-process half): per-fn compile counters stay
+    FLAT over a steady-shape fit loop after warmup, while a shape-churning
+    loop grows them, fires `recompiles_after_warmup`, and the firing alert
+    is served at UIServer /alerts."""
+    from deeplearning4j_tpu.ui import UIServer
+
+    reg = MetricsRegistry()
+    net = _net()
+    rec = FlightRecorder(proc="churn-test")
+    flight.set_flight_recorder(rec)
+    try:
+        with RecompileWatchdog(registry=reg):
+            engine = AlertEngine(registry=reg)
+            ds = _batch()
+            for _ in range(3):  # warmup: one signature, one compile
+                net._fit_batch(ds)
+            engine.mark_warmup_done()
+
+            def per_fn():
+                return {s["labels"]["fn"]: s["value"] for s in
+                        reg.get("tdl_xla_compiles_total").snapshot()["series"]}
+
+            at_warmup = per_fn()
+            assert at_warmup.get("MultiLayerNetwork.train_step", 0) >= 1
+            for _ in range(5):  # steady shapes: NO fn may compile again
+                net._fit_batch(ds)
+            assert per_fn() == at_warmup
+            assert not [a for a in engine.evaluate() if a["firing"]]
+
+            for n in (6, 7, 9):  # deliberate batch-size churn
+                net._fit_batch(_batch(n))
+            after_churn = per_fn()
+            assert after_churn["MultiLayerNetwork.train_step"] == \
+                at_warmup["MultiLayerNetwork.train_step"] + 3
+
+            server = UIServer(port=0)
+            try:
+                server.attach_registry(reg)
+                server.attach_alerts(engine)
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{server.port}/alerts",
+                        timeout=10) as r:
+                    payload = json.loads(r.read())
+            finally:
+                server.stop()
+            assert "recompiles_after_warmup" in payload["firing"]
+            row = {a["rule"]: a for a in payload["alerts"]}[
+                "recompiles_after_warmup"]
+            assert row["value"] >= 3 and row["severity"] == "critical"
+        # the watchdog left per-compile flight events carrying the fn
+        compiles = [e for e in rec.events() if e["kind"] == "compile"]
+        assert any(e["fn"] == "MultiLayerNetwork.train_step"
+                   for e in compiles)
+        assert all("seconds" in e for e in compiles)
+        # ...and the fired alert is on the same timeline
+        assert any(e["kind"] == "alert"
+                   and e["rule"] == "recompiles_after_warmup"
+                   for e in rec.events())
+    finally:
+        flight.set_flight_recorder(None)
+
+
+def test_compile_churn_postmortem_section_aggregates_events():
+    from deeplearning4j_tpu.parallel.supervisor import _compile_churn
+
+    events = [
+        {"kind": "compile", "proc": "rank0", "fn": "f", "seconds": 0.5},
+        {"kind": "compile", "proc": "rank0", "fn": "f", "seconds": 0.25},
+        {"kind": "compile", "proc": "rank1", "fn": "g", "seconds": 1.0},
+        {"kind": "step_begin", "proc": "rank0", "iteration": 3},
+    ]
+    rows = _compile_churn(events)
+    assert rows[0] == {"proc": "rank0", "fn": "f", "compiles": 2,
+                       "seconds": 0.75}
+    assert rows[1]["fn"] == "g" and rows[1]["compiles"] == 1
+    assert _compile_churn([{"kind": "step_begin"}]) == []
+
+
+def test_signature_lru_bounds_table_and_counts_evictions():
+    """ISSUE 10 satellite: the per-fn signature table is an LRU bounded at
+    max_signatures_per_fn; sustained churn evicts instead of leaking."""
+    from deeplearning4j_tpu.monitoring import watchdogs as wd_mod
+
+    reg = MetricsRegistry()
+    wd = RecompileWatchdog(registry=reg, max_signatures_per_fn=4,
+                           window_steps=1000, churn_threshold=1000)
+    wd.install()
+    try:
+        for i in range(10):
+            wd.note_signature("f", ("sig", i))
+        stats = wd.stats()
+        assert stats["signatures"]["f"] == 4  # bounded, not 10
+        assert reg.get("tdl_jit_signature_evictions_total") \
+                  .labels("f").value == 6
+        # LRU: touching an old-but-kept signature keeps it resident
+        wd.note_signature("f", ("sig", 7))  # hit → move to end
+        wd.note_signature("f", ("sig", 99))  # evicts sig 6, not sig 7
+        assert ("sig", 7) in wd._seen["f"]
+        assert ("sig", 6) not in wd._seen["f"]
+    finally:
+        wd.close()
+    assert wd_mod.UNATTRIBUTED == "_unattributed"
+
+
+# ---------------------------------------------------- alert-rule AST lint
+
+
+def _declared_families() -> set:
+    decl = re.compile(
+        r'\.(?:counter|gauge|histogram)\(\s*["\'](tdl_[a-z0-9_]+)["\']')
+    declared = set(aggregate.DERIVED_FAMILIES)
+    for path in sorted((ROOT / "deeplearning4j_tpu").rglob("*.py")):
+        declared.update(decl.findall(path.read_text()))
+    return declared
+
+
+def test_alert_rules_reference_declared_families():
+    """Repo lint (ISSUE 10 satellite): every AlertRule(...) in library code
+    must name a metric family some registry declares (or a derived family
+    from aggregate.DERIVED_FAMILIES) as a LITERAL — renaming a metric
+    therefore fails the build instead of silently rotting its alert."""
+    declared = _declared_families()
+    assert len(declared) > 30
+    offenders, found = [], 0
+    for path in sorted((ROOT / "deeplearning4j_tpu").rglob("*.py")):
+        rel = path.relative_to(ROOT).as_posix()
+        tree = ast.parse(path.read_text(), filename=rel)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and ((isinstance(node.func, ast.Name)
+                          and node.func.id == "AlertRule")
+                         or (isinstance(node.func, ast.Attribute)
+                             and node.func.attr == "AlertRule"))):
+                continue
+            found += 1
+            refs = {}
+            if len(node.args) >= 2:
+                refs["family"] = node.args[1]
+            for kw in node.keywords:
+                if kw.arg in ("family", "ratio_of"):
+                    refs[kw.arg] = kw.value
+            if "family" not in refs:
+                offenders.append(f"{rel}:{node.lineno} (no family argument)")
+                continue
+            for role, val in refs.items():
+                if not (isinstance(val, ast.Constant)
+                        and isinstance(val.value, str)):
+                    if role == "ratio_of" and isinstance(val, ast.Constant) \
+                            and val.value is None:
+                        continue
+                    offenders.append(
+                        f"{rel}:{node.lineno} ({role} is not a string literal)")
+                elif val.value not in declared:
+                    offenders.append(
+                        f"{rel}:{node.lineno} ({role}={val.value!r} is not a "
+                        "registry-declared or derived family)")
+    assert found >= 5  # the scan saw default_rules()
+    assert not offenders, (
+        "alert rules referencing unknown metric families (declare the "
+        f"family in a registry, or fix the rule): {offenders}")
+
+
+# ------------------------------------------------------------- slow tier
+
+
+@pytest.mark.slow
+def test_churning_crashed_gang_postmortem_carries_alert_and_compile_churn(tmp_path):
+    """ISSUE 10 acceptance (gang half, reusing the PR 2 fault injector): a
+    shape-churning gang member fires the recompile alert, then a crash is
+    injected — the postmortem's merged event stream contains the alert AND
+    the attributed compile events, and the compile_churn section names the
+    churning fn. The respawned incarnation trains steady-shape and reports
+    per-fn compiles FLAT after warmup."""
+    from deeplearning4j_tpu.parallel import GangSupervisor
+
+    env = {"TDL_MP_OUT": str(tmp_path / "out.json"),
+           "TDL_MATMUL_PRECISION": "float32",
+           "TDL_FAULT_SPEC": "crash@iter=10,rank=1",
+           "TDL_FLIGHT_INTERVAL": "0",
+           "TDL_METRICS_SPOOL_INTERVAL": "0"}
+    sup = GangSupervisor(f"{WORKERS}:churn_train", n_processes=2,
+                         n_local_devices=2, extra_env=env,
+                         workdir=str(tmp_path / "gang"),
+                         heartbeat_interval=0.0, startup_grace=300.0,
+                         backoff_base=0.1, kill_grace=1.0, max_restarts=3,
+                         registry=MetricsRegistry())
+    results = sup.run(timeout=540.0)
+    for r in results:
+        assert r.returncode == 0, f"rank {r.rank} failed:\n{r.stderr[-3000:]}"
+    assert sup.restarts >= 1
+
+    with open(sup.postmortem_path) as f:
+        pm = json.load(f)
+    assert pm["classification"] == "crash"
+    # the fired recompile alert is ON the postmortem timeline
+    alerts = [e for e in pm["events"] if e["kind"] == "alert"]
+    assert any(e["rule"] == "recompiles_after_warmup" for e in alerts)
+    # attributed compile events made it too, and the churn section names
+    # the churning fit fn as the top offender for some rank
+    compiles = [e for e in pm["events"] if e["kind"] == "compile"]
+    assert any(e["fn"] == "MultiLayerNetwork.train_step" for e in compiles)
+    churn_fns = {row["fn"] for row in pm["compile_churn"]}
+    assert "MultiLayerNetwork.train_step" in churn_fns
+
+    # final (steady, respawned) incarnation: flat after warmup per fn, and
+    # the steady evaluation before churn never fired
+    for rank in (0, 1):
+        with open(env["TDL_MP_OUT"] + f".rank{rank}") as f:
+            out = json.load(f)
+        assert out["incarnation"] >= 1
+        assert not out["steady_firing"]
+        assert not out["churn_firing"]  # no churn in the steady incarnation
+        assert out["per_fn_compiles_final"] == out["per_fn_compiles_warmup"]

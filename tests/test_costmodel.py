@@ -1,0 +1,239 @@
+"""Cost & memory attribution observatory (ISSUE 10 tentpole, layer 1).
+
+The acceptance gate: the per-layer estimator accounts ≥90% of XLA's own
+cost_analysis total for LeNet (MultiLayerNetwork over conf layers) and the
+functional transformer — plus unit coverage of the per-layer formulas, the
+HBM breakdown and the exported gauge families.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitoring import MetricsRegistry, costmodel
+from deeplearning4j_tpu.nn.conf import (BatchNormalization, ConvolutionLayer,
+                                        DenseLayer, EmbeddingLayer, InputType,
+                                        LSTM, SubsamplingLayer)
+
+
+# ------------------------------------------------------- per-layer formulas
+
+
+def test_dense_flops_formula():
+    l = DenseLayer(n_in=64, n_out=32)
+    # 2·MACs + bias adds
+    assert l.flops_per_example(InputType.feed_forward(64)) == 2 * 64 * 32 + 32
+
+
+def test_dense_time_distributed_multiplies_by_T():
+    l = DenseLayer(n_in=8, n_out=4)
+    ff = l.flops_per_example(InputType.feed_forward(8))
+    rnn = l.flops_per_example(InputType.recurrent(8, 10))
+    assert rnn == 10 * ff
+
+
+def test_conv_flops_counts_valid_taps_only():
+    it = InputType.convolutional(8, 8, 3)
+    full = ConvolutionLayer(n_out=16, kernel_size=(3, 3), padding=(0, 0))
+    # VALID 3x3 over 8x8 → 6x6 outputs, every tap valid
+    assert full.flops_per_example(it) == 2 * 6 * 6 * 9 * 3 * 16
+    same = ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                            convolution_mode="same")
+    # SAME pads the border; XLA counts only in-bounds taps, so the SAME
+    # flops are strictly below the naive out_h*out_w*k*k product
+    naive = 2 * 8 * 8 * 9 * 3 * 16
+    got = same.flops_per_example(it)
+    assert got < naive
+    # per-dim valid taps for size 8, k=3, s=1, SAME: 2 + 3*6 + 2... = 22
+    assert got == 2 * (22 * 22) * 3 * 16
+
+
+def test_lstm_and_misc_layer_flops_positive():
+    it = InputType.recurrent(16, 20)
+    assert LSTM(n_in=16, n_out=8).flops_per_example(it) > \
+        20 * (2 * 16 * 32 + 2 * 8 * 32)  # projections at least
+    assert SubsamplingLayer().flops_per_example(
+        InputType.convolutional(8, 8, 4)) > 0
+    assert BatchNormalization().flops_per_example(
+        InputType.convolutional(8, 8, 4)) == 8 * 8 * 8 * 4
+    # embedding is a gather: ~no flops beyond the output write
+    assert EmbeddingLayer(n_in=1000, n_out=16).flops_per_example(
+        InputType.feed_forward(1000)) == 16
+
+
+# -------------------------------------------------- acceptance: coverage ≥ 90%
+
+
+def _lenet(batch):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import LeNet
+
+    net = LeNet(num_classes=10).init()
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch, 1, 28, 28).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rs.randint(0, 10, batch)])
+    args = (net.params_, net.updater_state, net.bn_state,
+            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32), x, y,
+            None, None, jax.random.key(0))
+    return net, net._train_step_fn(), args
+
+
+def test_lenet_layer_costs_cover_xla_total():
+    """Acceptance (ISSUE 10): per-layer table accounts ≥90% of the XLA
+    cost-analysis total for the compiled LeNet train step."""
+    net, step, args = _lenet(batch=16)
+    xla = costmodel.xla_step_cost(step, *args)
+    assert xla["flops"] > 0
+    table = costmodel.cost_table(costmodel.layer_costs(net, 16), xla)
+    assert 0.9 <= table["coverage"] <= 1.25, table["coverage"]
+    # conv2 is LeNet's dominant layer; the table must say so
+    top = max(table["layers"], key=lambda r: r["pct"])
+    assert top["kind"] == "ConvolutionLayer"
+    assert sum(r["pct"] for r in table["layers"]) == pytest.approx(100, abs=1)
+    # memory analysis rode along
+    assert xla["peak_bytes"] > 0
+    assert xla["argument_bytes"] > 0
+
+
+def test_transformer_layer_costs_cover_xla_total():
+    """Acceptance (ISSUE 10): same gate for the functional transformer's
+    compiled MLM train step (tiny config, gathered mlm_positions)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import transformer as tr
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    cfg = tr.TransformerConfig.tiny(dropout=0.0)
+    B, T = 2, 64
+    params = tr.init_params(jax.random.key(0), cfg)
+    upd = Adam(1e-4)
+    opt = upd.init(params)
+    step = jax.jit(tr.make_train_step(cfg, upd), donate_argnums=(0, 1))
+    P = max(1, int(T * 0.15))
+    rs = np.random.RandomState(0)
+    pos = np.stack([np.sort(rs.choice(T, P, replace=False)) for _ in range(B)])
+    batch = {
+        "tokens": jnp.asarray(rs.randint(0, cfg.vocab_size, (B, T)), jnp.int32),
+        "mlm_positions": jnp.asarray(pos, jnp.int32),
+        "labels": jnp.asarray(rs.randint(0, cfg.vocab_size, (B, P)), jnp.int32),
+        "weights": jnp.ones((B, P), jnp.float32),
+    }
+    xla = costmodel.xla_step_cost(step, params, opt, batch,
+                                  jnp.asarray(0, jnp.int32), jax.random.key(1))
+    rows = tr.layer_costs(cfg, B, T, mlm_positions=P)
+    table = costmodel.cost_table(rows, xla)
+    assert 0.9 <= table["coverage"] <= 1.25, table["coverage"]
+    names = [r["layer"] for r in rows]
+    assert names == ["embed"] + [f"block{i}" for i in range(cfg.n_layers)] + \
+        ["mlm_head"]
+    # blocks dominate a transformer step
+    assert sum(r["pct"] for r in table["layers"]
+               if r["kind"] == "TransformerBlock") > 80
+
+
+# ------------------------------------------------------------ graph support
+
+
+def test_layer_costs_walks_computation_graph_nodes():
+    from deeplearning4j_tpu.nn import ComputationGraph, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import OutputLayer
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_in=6, n_out=12, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=12, n_out=3, activation="softmax",
+                                          loss="mcxent"), "d1")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(6))
+            .build())
+    net = ComputationGraph(conf).init()
+    rows = costmodel.layer_costs(net, batch=8)
+    by_name = {r["layer"]: r for r in rows}
+    assert by_name["d1"]["flops"] == (2 * 6 * 12 + 12) * 8 * 3.0
+    assert by_name["d1"]["param_bytes"] == (6 * 12 + 12) * 4
+    assert by_name["out"]["kind"] == "OutputLayer"
+
+
+# ------------------------------------------------------------ HBM breakdown
+
+
+def test_live_hbm_breakdown_buckets_by_owner():
+    net, _, _ = _lenet(batch=4)
+    reg = MetricsRegistry()
+    out = costmodel.net_hbm_breakdown(net, model="lenet", registry=reg)
+    param_bytes = sum(r["param_bytes"]
+                      for r in costmodel.layer_costs(net, 1))
+    assert out["params"] == param_bytes
+    assert out["opt_state"] > 0        # Adam m/v live on device
+    assert out["bn_state"] == 0        # LeNet has no BN
+    series = reg.get("tdl_hbm_bytes").snapshot()["series"]
+    kinds = {s["labels"]["kind"]: s["value"] for s in series
+             if s["labels"]["model"] == "lenet"}
+    assert kinds["params"] == param_bytes
+    assert "other" in kinds
+
+
+def test_publish_exports_gauges_and_table():
+    net, step, args = _lenet(batch=4)
+    reg = MetricsRegistry()
+    xla = costmodel.xla_step_cost(step, *args)
+    table = costmodel.publish("lenet", costmodel.layer_costs(net, 4), xla,
+                              registry=reg)
+    assert table["coverage"] > 0
+    assert reg.get("tdl_model_flops_per_step").labels("lenet").value == \
+        xla["flops"]
+    assert reg.get("tdl_hbm_peak_bytes").labels("lenet").value == \
+        xla["peak_bytes"]
+    layer_series = reg.get("tdl_layer_cost_info").snapshot()["series"]
+    assert len([s for s in layer_series
+                if s["labels"]["model"] == "lenet"]) == len(table["layers"])
+
+
+def test_xla_step_cost_accepts_plain_callable():
+    import jax.numpy as jnp
+
+    c = costmodel.xla_step_cost(lambda a, b: a @ b,
+                                jnp.zeros((8, 16)), jnp.zeros((16, 4)))
+    assert c["flops"] >= 2 * 8 * 16 * 4
+
+
+# ------------------------------------------------- bench --compare satellite
+
+
+def test_compare_benchmarks_gates_throughput_regressions():
+    import bench
+
+    old = {"backend": "cpu", "configs": {
+        "resnet50": {"value": 100.0, "unit": "images/sec/chip"},
+        "bert": {"value": 1000.0, "unit": "tokens/sec/chip"},
+        "lenet": {"value": 30.0, "unit": "sec_to_95%_acc"},
+    }}
+    cur = {"backend": "cpu", "configs": {
+        "resnet50": {"value": 85.0, "unit": "images/sec/chip"},   # -15%: gate
+        "bert": {"value": 950.0, "unit": "tokens/sec/chip"},      # -5%: noise
+        "lenet": {"value": 60.0, "unit": "sec_to_95%_acc"},       # not a rate
+    }}
+    regs = bench.compare_benchmarks(cur, old)
+    assert [r["config"] for r in regs] == ["resnet50"]
+    assert regs[0]["ratio"] == pytest.approx(0.85)
+    # identical runs never regress
+    assert bench.compare_benchmarks(old, old) == []
+    # new/missing configs are not regressions (trajectories add configs)
+    assert bench.compare_benchmarks(
+        {"backend": "cpu", "configs": {"new": {"value": 1, "unit": "x/s"}}},
+        old) == []
+    # cross-backend comparisons are refused, not silently wrong
+    with pytest.raises(ValueError, match="cannot compare backends"):
+        bench.compare_benchmarks({"backend": "tpu", "configs": {}}, old)
+    # a config whose UNIT changed between runs is incomparable — skipped
+    # rather than ratioed into a fabricated regression
+    assert bench.compare_benchmarks(
+        {"backend": "cpu", "configs": {
+            "resnet50": {"value": 3.2, "unit": "batches/sec"}}}, old) == []
+    # a current value of 0 against a real baseline IS a (total) regression
+    zeroed = bench.compare_benchmarks(
+        {"backend": "cpu", "configs": {
+            "resnet50": {"value": 0.0, "unit": "images/sec/chip"}}}, old)
+    assert [r["config"] for r in zeroed] == ["resnet50"]

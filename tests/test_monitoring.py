@@ -197,8 +197,13 @@ def test_recompile_watchdog_shape_churn_warns_and_counts(caplog):
     sigs = reg.get("tdl_jit_new_signatures_total")
     assert sigs.labels("MultiLayerNetwork.train_step").value == 4
     # real XLA compiles were observed and timed
-    assert reg.get("tdl_xla_compiles_total").value > 0
-    assert reg.get("tdl_xla_compile_seconds_total").value > 0
+    # real XLA compiles were observed, timed, and fn-attributed (ISSUE 10)
+    compiles = {s["labels"]["fn"]: s["value"]
+                for s in reg.get("tdl_xla_compiles_total").snapshot()["series"]}
+    assert sum(compiles.values()) > 0
+    assert compiles.get("MultiLayerNetwork.train_step", 0) > 0
+    seconds = reg.get("tdl_xla_compile_seconds_total").snapshot()["series"]
+    assert sum(s["value"] for s in seconds) > 0
 
 
 def test_recompile_watchdog_stable_shapes_quiet(caplog):

@@ -521,3 +521,98 @@ def test_serving_chaos_32_clients(monkeypatch):
         server.stop(drain=True)  # nothing in flight; drain is a clean no-op
     finally:
         server.stop()
+
+
+# -------------------------------------------------- request IDs (ISSUE 10)
+
+
+def test_request_id_echoed_on_success_and_generated_when_absent():
+    model = SlowModel()
+    server = JsonModelServer(model, registry=MetricsRegistry()).start()
+    try:
+        body = json.dumps([[1.0, 2.0, 3.0, 4.0]]).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "client-abc-123"})
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            assert resp.headers["X-Request-Id"] == "client-abc-123"
+            out = json.loads(resp.read())
+        assert out["request_id"] == "client-abc-123"
+        # no client id → the server mints one and still echoes it
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            rid = resp.headers["X-Request-Id"]
+            out = json.loads(resp.read())
+        assert rid and out["request_id"] == rid
+    finally:
+        server.stop()
+
+
+def test_request_id_rides_error_responses_and_logs(caplog):
+    import logging
+
+    # 413 (body too big) and 429 (queue full) both carry the id in header
+    # AND error JSON; the queue-full shed also logs it executor-side
+    model = SlowModel(delay=0.6)
+    server = JsonModelServer(model, max_queue=1, max_body_bytes=256,
+                             registry=MetricsRegistry()).start()
+    try:
+        big = json.dumps([[0.0] * 2000]).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/predict", data=big,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "too-big-1"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=15)
+        assert ei.value.code == 413
+        assert ei.value.headers["X-Request-Id"] == "too-big-1"
+        assert json.loads(ei.value.read())["request_id"] == "too-big-1"
+
+        ok = json.dumps([[1.0, 2.0, 3.0, 4.0]]).encode()
+        results = []
+
+        def fire(rid):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/predict", data=ok,
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": rid})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    results.append((200, json.loads(resp.read())))
+            except urllib.error.HTTPError as e:
+                results.append((e.code, json.loads(e.read()),
+                                e.headers.get("X-Request-Id")))
+
+        with caplog.at_level(logging.DEBUG,
+                             logger="deeplearning4j_tpu.serving"):
+            # fill the 1-slot queue while the slow forward runs, then one
+            # more request must be shed with 429 + its id echoed
+            threads = [threading.Thread(target=fire, args=(f"rid-{i}",))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+                time.sleep(0.02)
+            for t in threads:
+                t.join(30.0)
+        shed = [r for r in results if r[0] == 429]
+        assert shed, f"no 429 among {[r[0] for r in results]}"
+        code, body429, hdr = shed[0]
+        assert body429["request_id"].startswith("rid-")
+        assert hdr == body429["request_id"]
+        assert any("admission queue full" in r.message and "rid-" in r.message
+                   for r in caplog.records)
+    finally:
+        server.stop()
+
+
+def test_request_id_sanitizes_garbage_header():
+    from deeplearning4j_tpu.serving.json_server import _request_id
+
+    assert _request_id("ok-id") == "ok-id"
+    generated = _request_id("bad\nid")
+    assert "\n" not in generated and len(generated) == 16
+    assert len(_request_id("x" * 500)) == 16  # over-long → replaced
+    assert len(_request_id(None)) == 16
