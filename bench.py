@@ -159,6 +159,10 @@ def _scale(on_tpu):
             "bert": dict(batch=16, seq=128, steps=40, warmup=3, tiny=False),
             "serving": dict(clients=16, requests=320, batch_limit=16,
                             features=64, classes=8, queue=256),
+            "serving_slo": dict(duration_s=20.0, base_rate=120.0, clients=32,
+                                burst_mult=10.0, batch_limit=16, features=64,
+                                classes=8, queue=256, slo_threshold_ms=250.0,
+                                slo_target=0.99),
             "bert_large_fsdp": dict(batch=8, seq=128, steps=8, warmup=2,
                                     large=True, tp=1),
         }
@@ -170,6 +174,10 @@ def _scale(on_tpu):
         "bert": dict(batch=2, seq=64, steps=3, warmup=1, tiny=True),
         "serving": dict(clients=4, requests=80, batch_limit=8,
                         features=16, classes=4, queue=64),
+        "serving_slo": dict(duration_s=6.0, base_rate=40.0, clients=8,
+                            burst_mult=6.0, batch_limit=8, features=16,
+                            classes=4, queue=64, slo_threshold_ms=250.0,
+                            slo_target=0.99),
         "bert_large_fsdp": dict(batch=2, seq=64, steps=2, warmup=1,
                                 large=False, tp=1),
     }
@@ -990,6 +998,101 @@ def bench_serving(p):
     }
 
 
+def bench_serving_slo(p):
+    """ISSUE 11: SLO attainment under REPLAYED realistic traffic — a seeded
+    diurnal+burst trace through the full client→HTTP→queue→executor stack,
+    latency measured client-side, with a history ring + SLO tracker + alert
+    engine evaluating live during the replay. The report is what ROADMAP 1's
+    autoscaler bench consumes: attainment, error-budget remaining, burn
+    rate, and which alert rules fired under the burst."""
+    import threading
+
+    from deeplearning4j_tpu.monitoring import (AlertEngine, HistoryRing,
+                                               SloTracker, default_objectives,
+                                               default_rules, get_registry)
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving import (Burst, JsonModelServer,
+                                            LoadGenerator, TraceSpec)
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3)).list()
+            .layer(DenseLayer(n_in=p["features"], n_out=128, activation="relu"))
+            .layer(OutputLayer(n_out=p["classes"], activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    warm = np.zeros((1, p["features"]), np.float32)
+    server = (JsonModelServer.Builder(net).port(0)
+              .batch_limit(p["batch_limit"]).queue_size(p["queue"])
+              .warmup_input(warm).build().start())
+    if not server.wait_ready(60.0):
+        server.stop()
+        return {"metric": "slo_attainment", "value": 0.0, "unit": "ratio",
+                "error": "server never became ready"}
+    dur = p["duration_s"]
+    spec = TraceSpec(
+        duration_s=dur, base_rate=p["base_rate"], seed=0,
+        diurnal_amplitude=0.4,  # one compressed "day" over the replay
+        bursts=(Burst(0.5 * dur, 0.15 * dur, p["burst_mult"]),),
+        deadline_mix=((0.9, None), (0.1, 2_000.0)))
+    threshold_s = p["slo_threshold_ms"] / 1e3
+    window_s = max(2.0, dur / 4)
+    ring = HistoryRing(registry=get_registry(), interval=0.0)
+    tracker = SloTracker(
+        default_objectives(latency_threshold_s=threshold_s,
+                           target=p["slo_target"], window_s=window_s),
+        history_view=ring, registry=get_registry(),
+        burn_windows=(("fast", window_s / 2), ("slow", window_s * 2)))
+    engine = AlertEngine(
+        default_rules(p99_latency_s=threshold_s,
+                      latency_window_s=window_s,
+                      shed_window_s=window_s),
+        registry=get_registry(), history_view=ring)
+    fired, stop_eval = set(), threading.Event()
+
+    def evaluate_loop():  # live evaluation at scrape cadence during replay
+        while not stop_eval.is_set():
+            ring.sample(force=True)
+            tracker.evaluate()
+            fired.update(a["rule"] for a in engine.evaluate() if a["firing"])
+            stop_eval.wait(0.2)
+
+    evaluator = threading.Thread(target=evaluate_loop, daemon=True)
+    evaluator.start()
+    try:
+        report = LoadGenerator(
+            spec, server.port, n_clients=p["clients"],
+            payload=np.random.RandomState(0)
+            .randn(1, p["features"]).astype(np.float32).tolist(),
+            slo_threshold_ms=p["slo_threshold_ms"],
+            slo_target=p["slo_target"]).run()
+    finally:
+        stop_eval.set()
+        evaluator.join(10.0)
+        server.stop(drain=True)
+    slo_rows = {r["slo"]: r for r in tracker.evaluate()}
+    serving_lat = slo_rows.get("serving_latency", {})
+    return {
+        "metric": "slo_attainment",
+        "value": report["slo"]["attainment"],
+        "unit": "ratio",
+        "offered": report["offered"],
+        "offered_rate_per_s": report["offered_rate_per_s"],
+        "outcomes": report["outcomes"],
+        "p99_ms": report["latency_ms"]["p99"],
+        "slo": report["slo"],
+        "tracker": {
+            "attainment": serving_lat.get("attainment"),
+            "error_budget_remaining":
+                serving_lat.get("error_budget_remaining"),
+            "burn_rate": serving_lat.get("burn_rate"),
+        },
+        "alerts_fired_during_replay": sorted(fired),
+        "trace": spec.to_dict(),
+    }
+
+
 # --------------------------------------------------------------------- driver
 
 
@@ -1019,7 +1122,7 @@ def _baseline_ratio(backend, value, config):
 
 BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "w2v": bench_w2v, "bert": bench_bert, "serving": bench_serving,
-           "bert_large_fsdp": bench_fsdp}
+           "serving_slo": bench_serving_slo, "bert_large_fsdp": bench_fsdp}
 
 
 # -------------------------------------------------------- regression compare

@@ -17,11 +17,21 @@
   ``cost_analysis()`` of the compiled step, plus the live-HBM breakdown
   (ISSUE 10);
 - :mod:`.alerts` — declarative SLO rules evaluated at scrape time, served
-  at ``UIServer /alerts``, firing edges recorded into the flight ring.
+  at ``UIServer /alerts``, firing/clearing edges recorded into the flight
+  ring (windowed rules, rates and percentiles read the history ring);
+- :mod:`.history` — the time dimension: a bounded ring of timestamped
+  registry snapshots, per-proc spools merged at read time, served at
+  ``UIServer /history``, plus the shared window math (rates, deltas,
+  bucket-interpolated quantiles) every windowed consumer uses (ISSUE 11);
+- :mod:`.slo` — declarative SLO objectives compiled against the history
+  ring: attainment, error-budget remaining and burn rate exported as
+  ``tdl_slo_*`` gauges and served at ``UIServer /slo``.
 """
 
 from .aggregate import MetricsSpooler, maybe_spool, merged_prometheus
 from .alerts import AlertEngine, AlertRule, default_rules
+from .history import HistoryRing, HistoryView
+from .slo import SloObjective, SloTracker, default_objectives
 from .costmodel import (cost_table, layer_costs, live_hbm_breakdown,
                         net_hbm_breakdown, xla_step_cost)
 from .etl import etl_metrics
@@ -42,6 +52,11 @@ __all__ = [
     "AlertEngine",
     "AlertRule",
     "default_rules",
+    "HistoryRing",
+    "HistoryView",
+    "SloObjective",
+    "SloTracker",
+    "default_objectives",
     "cost_table",
     "layer_costs",
     "live_hbm_breakdown",

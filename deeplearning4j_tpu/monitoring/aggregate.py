@@ -34,6 +34,7 @@ import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from . import history
 from .flight import atomic_json_write, proc_name, proc_rank, scan_spool_json
 from .registry import (MetricsRegistry, _escape_help, _escape_label_value,
                        _fmt_value, get_registry)
@@ -119,6 +120,10 @@ def maybe_spool(force: bool = False) -> None:
     the ETL iterator's telemetry publish and the serving executor's batch
     cycle — the three process kinds the aggregated ``/metrics`` covers."""
     global _spooler, _spooler_key
+    # the history ring rides the same hook sites (trainer step, ETL publish,
+    # serving batch cycle) on its OWN env contract: one env lookup when
+    # TDL_HISTORY_DIR is unset, independent of the metrics-spool contract
+    history.maybe_sample(force=force)
     directory = os.environ.get(ENV_DIR)
     if not directory:
         return
@@ -138,15 +143,54 @@ def maybe_spool(force: bool = False) -> None:
 # -- merge -------------------------------------------------------------------
 
 
-def read_spools(directory: str) -> List[dict]:
+def spool_read_errors(registry: Optional[MetricsRegistry] = None):
+    """Get-or-create the spool-degradation counter (one declaration site):
+    spool files the scrape-time merge had to skip, by the proc identity in
+    the filename (``unknown`` when the name itself is mangled)."""
+    r = registry if registry is not None else get_registry()
+    return r.counter(
+        "tdl_spool_read_errors_total",
+        "metrics spool files skipped by the scrape-time merge "
+        "(unreadable, torn, or not a JSON object)", labels=("proc",))
+
+
+def _spool_proc_from_filename(name: str) -> str:
+    # tdl_metrics_<proc>.<pid>.json — proc may itself contain dots, so strip
+    # the two KNOWN trailing components, not the first dot
+    stem = name[len(SPOOL_PREFIX):]
+    parts = stem.rsplit(".", 2)
+    return parts[0] if len(parts) == 3 and parts[0] else "unknown"
+
+
+def read_spools(directory: str,
+                registry: Optional[MetricsRegistry] = None) -> List[dict]:
     """Parse every spool in ``directory``, keeping only the NEWEST file per
     proc identity (a restarted incarnation leaves its predecessor's spool
     behind; double-counting both would inflate every counter). The dedup
     needs a restart-stable proc identity — ``rank{N}`` or an explicit
     ``TDL_PROC_NAME``; fallback ``pid{N}`` identities change on restart, so
-    such spools accumulate until the directory is rotated."""
+    such spools accumulate until the directory is rotated.
+
+    Unreadable / torn / non-object spool files are SKIPPED and counted in
+    ``tdl_spool_read_errors_total{proc}`` on ``registry`` (default: the
+    process registry) — one corrupt file degrades one proc's view, never
+    the whole merged scrape, and the degradation counter lands on the SAME
+    registry the caller's scrape serves (ISSUE 11 satellite)."""
+    errors = spool_read_errors(registry)
+
+    def note_error(name: str) -> None:
+        errors.labels(_spool_proc_from_filename(name)).inc()
+
     newest: Dict[str, dict] = {}
-    for payload in scan_spool_json(directory, SPOOL_PREFIX):
+    for payload in scan_spool_json(directory, SPOOL_PREFIX,
+                                   on_error=note_error):
+        if not isinstance(payload, dict) \
+                or not isinstance(payload.get("snapshot", {}), dict):
+            # parsed but wrong shape: same degradation bucket
+            proc = (str(payload.get("proc") or "unknown")
+                    if isinstance(payload, dict) else "unknown")
+            errors.labels(proc).inc()
+            continue
         proc = str(payload.get("proc", ""))
         if (proc not in newest
                 or payload.get("wall", 0) >= newest[proc].get("wall", 0)):
@@ -196,7 +240,7 @@ def merged_prometheus(directory: str,
     """ONE text exposition over every process's spool (plus, optionally, the
     scraping process's own live registry), ``proc``/``rank`` labels on every
     series, derived straggler gauges appended."""
-    spools = read_spools(directory)
+    spools = read_spools(directory, registry=local_registry)
     entries: List[Tuple[str, Optional[int], dict]] = [
         (str(s.get("proc")), s.get("rank"), s.get("snapshot") or {})
         for s in spools]
@@ -290,7 +334,7 @@ def merged_snapshot(directory: str,
     """JSON twin of :func:`merged_prometheus` (``/metrics.json`` with a spool
     dir attached): per-proc snapshots keyed by proc, plus the derived
     straggler block."""
-    spools = read_spools(directory)
+    spools = read_spools(directory, registry=local_registry)
     out = {
         "procs": {str(s.get("proc")): {"rank": s.get("rank"),
                                        "pid": s.get("pid"),

@@ -82,11 +82,13 @@ def atomic_json_write(path: str, payload: dict) -> None:
     os.replace(tmp, path)
 
 
-def scan_spool_json(directory: str, prefix: str) -> List[dict]:
+def scan_spool_json(directory: str, prefix: str,
+                    on_error=None) -> List[dict]:
     """Parse every ``{prefix}*.json`` spool in ``directory``, name-sorted;
     unreadable/torn files are skipped (a reader racing a crash must not
     raise — the writer re-replaces shortly, or the postmortem proceeds with
-    what survived)."""
+    what survived). ``on_error(filename)`` is called per skipped file so
+    callers can count degradation instead of silently losing procs."""
     out = []
     try:
         names = sorted(os.listdir(directory))
@@ -99,6 +101,8 @@ def scan_spool_json(directory: str, prefix: str) -> List[dict]:
             with open(os.path.join(directory, name)) as f:
                 out.append(json.load(f))
         except (OSError, ValueError):
+            if on_error is not None:
+                on_error(name)
             continue
     return out
 

@@ -15,6 +15,13 @@ Families::
     tdl_inference_queue_wait_seconds        time from admission to batching
     tdl_inference_latency_seconds           end-to-end request latency
     tdl_inference_batch_size                coalesced rows per executor cycle
+
+Client-side families (ISSUE 11 satellite — SLO math grounded where users
+live, not only at the server)::
+
+    tdl_client_request_seconds{outcome}     client-observed request wall time
+                                            (retries included), by outcome
+    tdl_client_retries_total{reason}        retry attempts by trigger
 """
 
 from __future__ import annotations
@@ -51,4 +58,24 @@ def serving_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespa
             "tdl_inference_batch_size",
             "rows coalesced into one inference cycle",
             buckets=BATCH_SIZE_BUCKETS),
+    )
+
+
+def client_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespace:
+    """Get-or-create the CLIENT-side metric families on ``registry``.
+
+    Outcomes: ``ok``, ``bad_request`` (4xx, never retried), ``shed``
+    (429/503 after retries), ``deadline`` (504), ``server_error`` (other
+    5xx), ``connection``, ``breaker_open``. The latency histogram measures
+    what the caller experienced — the whole ``predict()`` including
+    backoff — which is the number client-grounded SLOs must judge."""
+    r = registry if registry is not None else get_registry()
+    return SimpleNamespace(
+        request_seconds=r.histogram(
+            "tdl_client_request_seconds",
+            "client-observed request wall seconds (retries and backoff "
+            "included), by outcome", labels=("outcome",)),
+        retries=r.counter(
+            "tdl_client_retries_total",
+            "client retry attempts by trigger", labels=("reason",)),
     )

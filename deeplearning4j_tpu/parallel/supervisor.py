@@ -44,7 +44,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..monitoring import aggregate, flight
+from ..monitoring import aggregate, flight, history
 from ..monitoring.flight import FlightRecorder
 from ..monitoring.heartbeat import ENV_DIR, ENV_INTERVAL, read_heartbeat
 from ..monitoring.registry import MetricsRegistry, get_registry
@@ -94,6 +94,56 @@ def _compile_churn(events: Sequence[dict]) -> List[dict]:
              "seconds": round(row["seconds"], 4)}
             for (proc, fn), row in sorted(
                 agg.items(), key=lambda kv: -kv[1]["compiles"])]
+
+
+def _alert_intervals(events: Sequence[dict]) -> List[dict]:
+    """Pair ``alert`` / ``alert_clear`` flight events into firing INTERVALS
+    per (proc, rule), longest first — the postmortem's answer to "what was
+    alerting, and for how long, while we died" (ISSUE 11: alert rules v2
+    record falling edges, so alerts have ends, not just onsets). An alert
+    still open at the end of the timeline reports ``end_t=None`` /
+    ``still_firing=True``."""
+    open_: Dict[Tuple[str, str], dict] = {}
+    out: List[dict] = []
+    for e in events:
+        kind = e.get("kind")
+        if kind not in ("alert", "alert_clear"):
+            continue
+        key = (str(e.get("proc", "?")), str(e.get("rule", "?")))
+        if kind == "alert":
+            # a duplicate rise without a clear (recorder ring evicted the
+            # clear): close the dangling interval open-ended first
+            if key in open_:
+                s = open_.pop(key)
+                out.append(_interval_row(key, s, None))
+            open_[key] = e
+        else:
+            s = open_.pop(key, None)
+            out.append(_interval_row(key, s, e))
+    for key, s in open_.items():
+        out.append(_interval_row(key, s, None))
+    return sorted(out, key=lambda r: -(r["duration"]
+                                       if r["duration"] is not None
+                                       else float("inf")))
+
+
+def _interval_row(key: Tuple[str, str], start: Optional[dict],
+                  end: Optional[dict]) -> dict:
+    src = start or end or {}
+    duration = None
+    if end is not None and end.get("duration") is not None:
+        duration = float(end["duration"])
+    elif start is not None and end is not None:
+        duration = float(end.get("t", 0.0)) - float(start.get("t", 0.0))
+    return {
+        "proc": key[0],
+        "rule": key[1],
+        "severity": src.get("severity"),
+        "start_t": start.get("t") if start else None,
+        "end_t": end.get("t") if end else None,
+        "duration": duration,
+        "still_firing": end is None,
+    }
 
 
 def _supervisor_metrics(registry: MetricsRegistry):
@@ -188,6 +238,8 @@ class GangSupervisor:
         self.postmortem_path = os.path.join(self.workdir, "postmortem.json")
         #: one stable spool dir for ALL attempts — attachable once
         self.spool_dir = os.path.join(self.workdir, "spool")
+        #: stable per-proc history-ring dir (ISSUE 11): windowed /history
+        self.history_dir = os.path.join(self.workdir, "history")
 
         self.events: List[GangEvent] = []
         self.restarts = 0           # budgeted restarts performed
@@ -291,8 +343,13 @@ class GangSupervisor:
         env.setdefault(flight.ENV_INTERVAL, str(self.heartbeat_interval))
         env.setdefault(aggregate.ENV_DIR, self.spool_dir)
         env.setdefault(aggregate.ENV_INTERVAL, str(self.heartbeat_interval))
+        # history rings (ISSUE 11) are STABLE across attempts like the
+        # metrics spool: windowed alert/SLO views spanning a restart are the
+        # point — read_rings dedupes incarnations by newest ring per proc
+        env.setdefault(history.ENV_DIR, os.path.join(self.workdir, "history"))
         self.flight_dir = env[flight.ENV_DIR]
         self.spool_dir = env[aggregate.ENV_DIR]
+        self.history_dir = env[history.ENV_DIR]
         procs = launcher.spawn(
             self.target, self.n_processes, self.n_local_devices,
             self.platform, extra_env=env, args=self.args, cwd=self.cwd,
@@ -457,6 +514,9 @@ class GangSupervisor:
             # count + seconds from the RecompileWatchdog's `compile` events,
             # worst first — "which function kept recompiling before we died"
             "compile_churn": _compile_churn(events),
+            # alert INTERVALS (ISSUE 11): paired alert/alert_clear edges —
+            # what was firing (and for how long) around the failure
+            "alert_intervals": _alert_intervals(events),
             "events": events,
         }
         tmp = self.postmortem_path + ".tmp"

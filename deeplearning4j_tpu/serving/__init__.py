@@ -14,6 +14,7 @@ executable). See docs/PARITY.md "Serving" for the DL4J mapping.
 from .executor import (BatchingInferenceExecutor, DeadlineExceededError,
                        ExecutorClosedError, InferenceFuture, QueueFullError)
 from .json_server import JsonModelServer, JsonModelClient
+from .loadgen import Burst, LoadGenerator, TraceSpec, replay
 
 __all__ = [
     "JsonModelServer",
@@ -23,4 +24,8 @@ __all__ = [
     "QueueFullError",
     "DeadlineExceededError",
     "ExecutorClosedError",
+    "Burst",
+    "LoadGenerator",
+    "TraceSpec",
+    "replay",
 ]

@@ -26,7 +26,13 @@ What production hardening adds on top of the DL4J shape:
   batch cycle (``slow_infer@p=`` / ``fail_infer@n=``), so the serving chaos
   tests wedge/fail the REAL inference path;
 - **observability**: every queue/batch/shed event lands in the
-  ``tdl_inference_*`` families (``monitoring.serving``).
+  ``tdl_inference_*`` families (``monitoring.serving``); SAMPLED requests
+  (deterministic by request-id hash, ``span_sample_n``) leave
+  ``request_span`` flight events carrying the per-phase
+  queue→batch-form→infer timeline keyed by request id (ISSUE 11) — shed
+  requests (queue-full, expired-in-queue, abandoned-mid-batch) leave one
+  under the same sampling decision, so a sampled 429/504's life is as
+  reconstructable as a sampled 200's.
 """
 
 from __future__ import annotations
@@ -44,6 +50,25 @@ from ..monitoring import aggregate, flight
 from ..monitoring.serving import serving_metrics
 
 log = logging.getLogger(__name__)
+
+
+def span_sampled(request_id: Optional[str], sample_n: int) -> bool:
+    """Deterministic request-span sampling: the SAME request id always
+    samples the same way at every stage (and across processes), so a
+    sampled request's timeline is complete, never half-recorded. Gated on
+    flight recording being active — an unsupervised process pays one env
+    lookup. ``sample_n=1`` records every request; ``N`` records ~1/N of
+    them (raise it on heavy production traffic so spans don't evict the
+    rest of the flight ring)."""
+    if not flight.active():
+        return False
+    if sample_n <= 1:
+        return True
+    if not request_id:
+        return False  # no id → no joinable timeline to sample
+    import zlib
+
+    return zlib.crc32(request_id.encode()) % sample_n == 0
 
 
 class QueueFullError(RuntimeError):
@@ -67,10 +92,10 @@ class InferenceFuture:
     """
 
     __slots__ = ("x", "deadline", "enqueued_at", "result", "error", "_done",
-                 "abandoned", "_lock", "request_id")
+                 "abandoned", "_lock", "request_id", "sampled", "span")
 
     def __init__(self, x: np.ndarray, deadline: Optional[float],
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None, sampled: bool = False):
         self.x = x
         self.deadline = deadline
         self.request_id = request_id
@@ -78,6 +103,13 @@ class InferenceFuture:
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.abandoned = False
+        #: span sampling (ISSUE 11): when True the executor fills ``span``
+        #: with per-phase seconds (queue / batch_form / infer) before
+        #: resolving — the HTTP layer adds serialize and records the
+        #: ``request_span`` flight event. Written by the inference thread,
+        #: read after ``_done`` is set (the Event is the memory barrier).
+        self.sampled = sampled
+        self.span: Optional[dict] = None
         self._done = threading.Event()
         self._lock = threading.Lock()  # serializes abandon() vs _expire()
 
@@ -130,16 +162,19 @@ class BatchingInferenceExecutor:
     def __init__(self, model=None, parallel_inference=None, *,
                  max_queue: int = 64, max_batch_rows: int = 128,
                  default_deadline_ms: Optional[float] = None,
-                 warmup_input=None, registry=None):
+                 warmup_input=None, registry=None, span_sample_n: int = 1):
         if model is None and parallel_inference is None:
             raise ValueError("need a model or a ParallelInference")
         self.model = model
         self.parallel_inference = parallel_inference
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if span_sample_n < 1:
+            raise ValueError(f"span_sample_n must be >= 1, got {span_sample_n}")
         self.max_queue = max_queue
         self.max_batch_rows = max_batch_rows
         self.default_deadline_ms = default_deadline_ms
+        self.span_sample_n = span_sample_n
         self._warmup_input = warmup_input
         self._m = serving_metrics(registry)
         self._q: deque = deque()
@@ -216,26 +251,38 @@ class BatchingInferenceExecutor:
                              "got a scalar")
         ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
         deadline = time.monotonic() + ms / 1000.0 if ms is not None else None
-        fut = InferenceFuture(arr, deadline, request_id=request_id)
+        sampled = span_sampled(request_id, self.span_sample_n)
+        fut = InferenceFuture(arr, deadline, request_id=request_id,
+                              sampled=sampled)
         with self._cv:
             if not self._accepting:
                 raise ExecutorClosedError("executor is not accepting requests")
-            if len(self._q) >= self.max_queue:
+            queue_full = len(self._q) >= self.max_queue
+            if queue_full:
                 self._m.shed.labels(reason="queue_full").inc()
                 # debug, not warning: queue-full is the EXPECTED overload
                 # behavior (thousands/sec under stress), and logging under
                 # the admission lock would serialize contended submitters
                 log.debug("request %s: admission queue full (%d queued)",
                           request_id, self.max_queue)
-                raise QueueFullError(
-                    f"admission queue full ({self.max_queue} queued)")
-            self._q.append(fut)
-            depth = len(self._q)
-            self._m.queue_depth.set(depth)
-            new_hwm = depth > self._depth_hwm
-            if new_hwm:
-                self._depth_hwm = depth
-            self._cv.notify()
+            else:
+                self._q.append(fut)
+                depth = len(self._q)
+                self._m.queue_depth.set(depth)
+                new_hwm = depth > self._depth_hwm
+                if new_hwm:
+                    self._depth_hwm = depth
+                self._cv.notify()
+        if queue_full:
+            if sampled:
+                # span timeline for the 429 (ISSUE 11 satellite): a rejected
+                # request's life is reconstructable too — recorded OUTSIDE
+                # the admission lock like every breadcrumb here
+                flight.record("request_span", request_id=request_id,
+                              outcome="shed_queue_full", code=429,
+                              queue_depth=self.max_queue, phases={})
+            raise QueueFullError(
+                f"admission queue full ({self.max_queue} queued)")
         if new_hwm:
             # black-box breadcrumb: rising watermarks are the overload
             # precursor a postmortem wants on the timeline (rare by
@@ -279,8 +326,9 @@ class BatchingInferenceExecutor:
                 # expired while queued: shed WITHOUT running the model —
                 # nobody is waiting for this answer anymore. An abandoned
                 # request was already counted by its waiter (reason=deadline)
-                if req._expire(DeadlineExceededError(
-                        "deadline expired while queued")):
+                owns_count = req._expire(DeadlineExceededError(
+                    "deadline expired while queued"))
+                if owns_count:
                     # the abandoned case already logged server-side; and like
                     # queue_full above this is the EXPECTED overload path —
                     # debug, so the single batch-pump thread never stalls on
@@ -289,6 +337,14 @@ class BatchingInferenceExecutor:
                     log.debug("request %s: expired in queue after %.3fs "
                               "(deadline passed before inference started)",
                               req.request_id, now - req.enqueued_at)
+                if req.sampled:
+                    # span timeline for the 504 (ISSUE 11 satellite): its
+                    # whole life was the queue, and the timeline says so
+                    flight.record("request_span",
+                                  request_id=req.request_id,
+                                  outcome="shed_deadline", code=504,
+                                  abandoned=not owns_count,
+                                  phases={"queue": now - req.enqueued_at})
             else:
                 live.append(req)
         if not live:
@@ -302,6 +358,8 @@ class BatchingInferenceExecutor:
         for req in live:
             groups.setdefault((str(req.x.dtype), req.x.shape[1:]), []).append(req)
         for reqs in groups.values():
+            rows = sum(r.x.shape[0] for r in reqs)
+            t_infer = time.monotonic()
             try:
                 fault_point("infer")
                 outs = self._run([r.x for r in reqs])
@@ -309,11 +367,52 @@ class BatchingInferenceExecutor:
                 log.warning("inference failed for requests [%s]: %s: %s",
                             ", ".join(str(r.request_id) for r in reqs),
                             type(e).__name__, e)
+                self._fill_spans(reqs, now, t_infer, rows)
                 for r in reqs:
                     r._resolve(error=e)
+                    self._record_abandoned_span(r)
                 continue
+            self._fill_spans(reqs, now, t_infer, rows)
             for r, out in zip(reqs, outs):
                 r._resolve(result=out)
+                self._record_abandoned_span(r)
+
+    @staticmethod
+    def _record_abandoned_span(r: InferenceFuture) -> None:
+        """A request whose waiter gave up (504) while its batch ran still
+        gets a span: the timeline shows WHERE its deadline went (a long
+        infer, a slow queue) — nobody else will record it, the waiter is
+        gone. Non-abandoned requests are recorded by their waiter (the
+        HTTP layer adds serialize), so this never double-records. The
+        abandoned read takes the future's lock: abandon() holds it across
+        its done-check + flag write, so this sees either the complete
+        abandon (record here, waiter 504'd) or none (abandon() will return
+        False and the waiter records the ok span) — never the in-between
+        where the sampled request loses its span on both sides."""
+        with r._lock:
+            abandoned = r.abandoned
+        if abandoned and r.sampled:
+            phases = dict(r.span or {})
+            rows = phases.pop("batch_rows", None)
+            flight.record("request_span", request_id=r.request_id,
+                          outcome="shed_deadline", code=504, abandoned=True,
+                          phases=phases, batch_rows=rows)
+
+    @staticmethod
+    def _fill_spans(reqs: List[InferenceFuture], t_pop: float,
+                    t_infer: float, rows: int) -> None:
+        """Attach per-phase seconds to each SAMPLED rider of this group,
+        BEFORE the futures resolve (the done-Event publishes the write):
+        queue = admission → batch pop, batch_form = pop → forward dispatch
+        (expiry sweep + grouping + concat prep), infer = the forward. The
+        waiter adds serialize and records the ``request_span`` event."""
+        t_end = time.monotonic()
+        for r in reqs:
+            if r.sampled:
+                r.span = {"queue": t_pop - r.enqueued_at,
+                          "batch_form": t_infer - t_pop,
+                          "infer": t_end - t_infer,
+                          "batch_rows": rows}
 
     def _run(self, xs: List[np.ndarray]) -> List[np.ndarray]:
         if self.parallel_inference is not None:

@@ -40,7 +40,8 @@ from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
-from ..monitoring.serving import serving_metrics
+from ..monitoring import flight
+from ..monitoring.serving import client_metrics, serving_metrics
 from .executor import (BatchingInferenceExecutor, DeadlineExceededError,
                        ExecutorClosedError, QueueFullError)
 
@@ -80,7 +81,7 @@ class JsonModelServer:
                  max_queue: int = 64, max_batch_rows: int = 128,
                  default_deadline_ms: float = DEFAULT_DEADLINE_MS,
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
-                 warmup_input=None, registry=None):
+                 warmup_input=None, registry=None, span_sample_n: int = 1):
         self.model = model
         self.deserializer = deserializer or (lambda d: np.asarray(d, np.float32))
         self.serializer = serializer or (lambda a: np.asarray(a).tolist())
@@ -93,6 +94,7 @@ class JsonModelServer:
         self.max_body_bytes = max_body_bytes
         self.warmup_input = warmup_input
         self.registry = registry
+        self.span_sample_n = span_sample_n
         self.port = port
         self._m = serving_metrics(registry)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -147,6 +149,15 @@ class JsonModelServer:
 
         def warmup_input(self, x):
             self._kw["warmup_input"] = x
+            return self
+
+        def span_sample(self, n: int):
+            """Record a ``request_span`` flight event for ~1/n of requests,
+            deterministically by request-id hash (1 = all requests; the
+            SAME decision covers ok and shed outcomes, so a sampled
+            request's timeline is always complete and an unsampled one
+            leaves nothing). Needs flight recording active."""
+            self._kw["span_sample_n"] = n
             return self
 
         def registry(self, r):
@@ -268,15 +279,41 @@ class JsonModelServer:
         if fut.error is not None:
             e = fut.error
             if isinstance(e, DeadlineExceededError):
+                # the executor recorded the shed_deadline span when it
+                # popped the expired request — don't double-record
                 return 504, {"error": str(e)}, None
             if isinstance(e, ExecutorClosedError):
                 return 503, {"error": str(e)}, RETRY_AFTER_S
+            self._record_span(fut, rid, "error", 500)
             return 500, {"error": f"{type(e).__name__}: {e}"}, None
+        t_ser = time.monotonic()
         try:
-            return 200, {"output": self.serializer(fut.result)}, None
+            body = {"output": self.serializer(fut.result)}
         except Exception as e:
+            self._record_span(fut, rid, "error", 500,
+                              serialize=time.monotonic() - t_ser)
             return 500, {"error": f"serializer failed: "
                                   f"{type(e).__name__}: {e}"}, None
+        self._record_span(fut, rid, "ok", 200,
+                          serialize=time.monotonic() - t_ser)
+        return 200, body, None
+
+    @staticmethod
+    def _record_span(fut, rid: str, outcome: str, code: int,
+                     serialize: Optional[float] = None) -> None:
+        """Complete a sampled request's ``request_span`` flight event
+        (ISSUE 11): the executor filled queue/batch_form/infer, the HTTP
+        layer owns serialize and the outcome. One event per request, keyed
+        by the same ``X-Request-Id`` that rides every response — a
+        timeline reconstructs with one grep."""
+        if not fut.sampled:
+            return
+        phases = dict(fut.span or {})
+        rows = phases.pop("batch_rows", None)
+        if serialize is not None:
+            phases["serialize"] = serialize
+        flight.record("request_span", request_id=rid, outcome=outcome,
+                      code=code, phases=phases, batch_rows=rows)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -293,7 +330,8 @@ class JsonModelServer:
             model=self.model, parallel_inference=pi,
             max_queue=self.max_queue, max_batch_rows=self.max_batch_rows,
             default_deadline_ms=self.default_deadline_ms,
-            warmup_input=self.warmup_input, registry=self.registry).start()
+            warmup_input=self.warmup_input, registry=self.registry,
+            span_sample_n=self.span_sample_n).start()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -399,7 +437,12 @@ class JsonModelClient:
       as HTTP errors, with the target URL in the message;
     - a consecutive-failure circuit breaker: after ``breaker_threshold``
       consecutive 5xx/429/connection failures the client fails fast for
-      ``breaker_cooldown`` seconds, then lets one probe through (half-open).
+      ``breaker_cooldown`` seconds, then lets one probe through (half-open);
+    - client-side telemetry (ISSUE 11 satellite): every ``predict()``
+      observes ``tdl_client_request_seconds{outcome}`` — the wall time the
+      CALLER experienced, retries and backoff included — and each retry
+      increments ``tdl_client_retries_total{reason}``, so SLO math can be
+      grounded where users live, not only at the server.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 9090,
@@ -407,7 +450,7 @@ class JsonModelClient:
                  retries: int = 3, backoff_base: float = 0.05,
                  backoff_max: float = 2.0, breaker_threshold: int = 8,
                  breaker_cooldown: float = 5.0,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None, registry=None):
         self.url = f"http://{host}:{port}{endpoint}"
         self.timeout = timeout
         self.retries = retries
@@ -416,6 +459,7 @@ class JsonModelClient:
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown = breaker_cooldown
         self.deadline_ms = deadline_ms
+        self._m = client_metrics(registry)
         self._consecutive_failures = 0
         self._open_until = 0.0
         self._breaker_lock = threading.Lock()
@@ -460,56 +504,97 @@ class JsonModelClient:
 
     # -- request -----------------------------------------------------------
 
-    def predict(self, data, deadline_ms: Optional[float] = None) -> Any:
+    @staticmethod
+    def _code_outcome(code: int) -> str:
+        if code in (429, 503):
+            return "shed"
+        if code == 504:
+            return "deadline"
+        if code >= 500:
+            return "server_error"
+        return "bad_request"
+
+    def predict(self, data, deadline_ms: Optional[float] = None,
+                request_id: Optional[str] = None) -> Any:
         import http.client
         import urllib.error
         import urllib.request
 
-        self._check_breaker()
+        t0 = time.perf_counter()
+        outcome = "connection"
+        try:
+            self._check_breaker()
+        except RuntimeError:
+            self._m.request_seconds.labels("breaker_open").observe(
+                time.perf_counter() - t0)
+            raise
         body = json.dumps(np.asarray(data).tolist()).encode()
         headers = {"Content-Type": "application/json"}
         ms = deadline_ms if deadline_ms is not None else self.deadline_ms
         if ms is not None:
             headers["X-Deadline-Ms"] = str(ms)
+        if request_id is not None:
+            # correlation key (ISSUE 11): the server echoes it and the
+            # executor's request_span timeline joins on it
+            headers["X-Request-Id"] = str(request_id)
         last_msg = f"no response from {self.url}"
-        for attempt in range(self.retries + 1):
-            retry_after = None
-            req = urllib.request.Request(self.url, data=body, headers=headers)
-            try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    out = json.loads(resp.read())
-                if "error" in out:
-                    raise RuntimeError(out["error"])
-                self._record_success()
-                return out["output"]
-            except urllib.error.HTTPError as e:
-                # non-2xx raises BEFORE the structured error body is read —
-                # surface the server's JSON error, not a bare "HTTP Error 400"
+        try:
+            for attempt in range(self.retries + 1):
+                retry_after = None
+                req = urllib.request.Request(self.url, data=body,
+                                             headers=headers)
                 try:
-                    detail = json.loads(e.read()).get("error", "")
-                except (ValueError, KeyError, AttributeError):
-                    detail = ""
-                last_msg = f"server returned HTTP {e.code}: {detail or e.reason}"
-                if e.code != 429 and e.code < 500:
-                    # the payload is wrong; retrying cannot fix it
-                    raise RuntimeError(last_msg) from None
-                retry_after = e.headers.get("Retry-After") if e.headers else None
-            except urllib.error.URLError as e:
-                last_msg = f"cannot reach {self.url}: {e.reason}"
-            except (OSError, http.client.HTTPException, ValueError) as e:
-                # a reset/truncation MID-RESPONSE (connection reset while
-                # reading the body, RemoteDisconnected, torn JSON) is a
-                # connection error like any other: the documented contract
-                # retries it, it must not escape as a raw ConnectionResetError
-                last_msg = (f"connection error to {self.url}: "
-                            f"{type(e).__name__}: {e}")
-            self._record_failure()
-            if attempt >= self.retries:
-                break
-            with self._breaker_lock:
-                breaker_open = (self._consecutive_failures
-                                >= self.breaker_threshold)
-            if breaker_open:
-                break
-            self._sleep_backoff(attempt, retry_after)
-        raise RuntimeError(last_msg) from None
+                    with urllib.request.urlopen(req,
+                                                timeout=self.timeout) as resp:
+                        out = json.loads(resp.read())
+                    if "error" in out:
+                        outcome = "server_error"
+                        raise RuntimeError(out["error"])
+                    self._record_success()
+                    outcome = "ok"
+                    return out["output"]
+                except urllib.error.HTTPError as e:
+                    # non-2xx raises BEFORE the structured error body is
+                    # read — surface the server's JSON error, not a bare
+                    # "HTTP Error 400"
+                    try:
+                        detail = json.loads(e.read()).get("error", "")
+                    except (ValueError, KeyError, AttributeError):
+                        detail = ""
+                    last_msg = (f"server returned HTTP {e.code}: "
+                                f"{detail or e.reason}")
+                    outcome = self._code_outcome(e.code)
+                    if e.code != 429 and e.code < 500:
+                        # the payload is wrong; retrying cannot fix it
+                        raise RuntimeError(last_msg) from None
+                    retry_reason = f"http_{e.code}"
+                    retry_after = (e.headers.get("Retry-After")
+                                   if e.headers else None)
+                except urllib.error.URLError as e:
+                    last_msg = f"cannot reach {self.url}: {e.reason}"
+                    outcome = "connection"
+                    retry_reason = "connection"
+                except (OSError, http.client.HTTPException, ValueError) as e:
+                    # a reset/truncation MID-RESPONSE (connection reset while
+                    # reading the body, RemoteDisconnected, torn JSON) is a
+                    # connection error like any other: the documented contract
+                    # retries it, it must not escape as a raw
+                    # ConnectionResetError
+                    last_msg = (f"connection error to {self.url}: "
+                                f"{type(e).__name__}: {e}")
+                    outcome = "connection"
+                    retry_reason = "connection"
+                self._record_failure()
+                if attempt >= self.retries:
+                    break
+                with self._breaker_lock:
+                    breaker_open = (self._consecutive_failures
+                                    >= self.breaker_threshold)
+                if breaker_open:
+                    break
+                self._m.retries.labels(retry_reason).inc()
+                self._sleep_backoff(attempt, retry_after)
+            raise RuntimeError(last_msg) from None
+        finally:
+            self._m.request_seconds.labels(outcome).observe(
+                time.perf_counter() - t0)

@@ -143,6 +143,9 @@ class _Handler(BaseHTTPRequestHandler):
     spool_dir = None  # metrics-spool dir → /metrics merges at scrape time
     spool_local_proc = "local"  # proc label for THIS process's registry
     alert_engine = None  # AlertEngine → /alerts evaluates at request time
+    history_ring = None  # HistoryRing → /history (sampled per request)
+    history_dir = None  # history-spool dir merged into /history at read time
+    slo_tracker = None  # SloTracker → /slo evaluates at request time
 
     def log_message(self, *args):
         pass
@@ -214,6 +217,75 @@ class _Handler(BaseHTTPRequestHandler):
             alerts = engine.evaluate()
             self._json({"alerts": alerts,
                         "firing": [a["rule"] for a in alerts if a["firing"]]})
+            return
+        if self.path.startswith("/history"):
+            # metrics history (ISSUE 11): the local ring (sampled on every
+            # request — a scraped server accrues history at scrape cadence)
+            # merged with per-proc ring spools, with family/label/window
+            # filters. Without ?family= the response is a summary.
+            from urllib.parse import parse_qs, urlparse
+
+            from ..monitoring import history as _history
+
+            if self.history_ring is None and not self.history_dir:
+                self._json({"error": "no history attached — "
+                                     "UIServer.attach_history(...)"}, 404)
+                return
+            if self.history_ring is not None:
+                self.history_ring.sample()
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                window = float(q["window"][0]) if q.get("window") else None
+            except ValueError:
+                self._json({"error": f"bad window {q['window'][0]!r} "
+                                     "(want seconds as a number)"}, 400)
+                return
+            family = q.get("family", [None])[0]
+            labels = {k[len("label."):]: v[0] for k, v in q.items()
+                      if k.startswith("label.")}
+            samples = _history.merged_samples(
+                self.history_dir, self.history_ring, window=window)
+            proc = q.get("proc", [None])[0]
+            if proc is not None:
+                samples = [s for s in samples if s.get("proc") == proc]
+            if family is None:
+                fams = sorted({n for s in samples
+                               for n in (s.get("snapshot") or {})})
+                self._json({"samples": len(samples),
+                            "procs": sorted({str(s.get("proc"))
+                                             for s in samples}),
+                            "window": window, "families": fams})
+                return
+            points = []
+            ftype = None
+            for s in samples:
+                fam = (s.get("snapshot") or {}).get(family)
+                if not fam:
+                    continue
+                ftype = fam.get("type", ftype)
+                for series in fam.get("series", []):
+                    if not _history.labels_match(
+                            series.get("labels") or {}, labels or None):
+                        continue
+                    points.append({"t": s["t"], "wall": s.get("wall"),
+                                   "proc": s.get("proc"),
+                                   "rank": s.get("rank"), **series})
+            self._json({"family": family, "type": ftype, "window": window,
+                        "labels": labels or None, "points": points})
+            return
+        if self.path.startswith("/slo"):
+            # SLO attainment / budget / burn (ISSUE 11): the tracker
+            # evaluates at request time over the same history the alert
+            # engine's burn rules read
+            tracker = self.slo_tracker
+            if tracker is None:
+                self._json({"error": "no SLO tracker attached — "
+                                     "UIServer.attach_slo(tracker)"}, 404)
+                return
+            rows = tracker.evaluate()
+            self._json({"slos": rows,
+                        "violating": [r["slo"] for r in rows
+                                      if r["state"] == "violating"]})
             return
         if self.path == "/sessions":
             self._json(self.storage.session_ids())
@@ -479,6 +551,51 @@ class UIServer:
         self._httpd.RequestHandlerClass.alert_engine = engine
 
     attachAlerts = attach_alerts
+
+    def attach_history(self, ring=None, directory: Optional[str] = None) -> None:
+        """Serve the metrics history ring at ``/history`` (ISSUE 11). With
+        no ``ring``, one is built over whatever registry is currently
+        attached and sampled on every ``/history`` request — a regularly
+        scraped server accrues history at scrape cadence with zero extra
+        wiring. ``directory`` additionally merges per-proc history-ring
+        spools (e.g. a ``GangSupervisor`` workdir's ``history`` dir) at
+        read time."""
+        if self._httpd is None:
+            self._start(self._storages[0] if self._storages else StatsStorage())
+        handler = self._httpd.RequestHandlerClass
+        if ring is None and directory is None:
+            from ..monitoring.history import HistoryRing
+
+            ring = HistoryRing(registry=handler.registry, interval=0.0)
+        handler.history_ring = ring
+        handler.history_dir = directory
+
+    attachHistory = attach_history
+
+    def attach_slo(self, tracker=None) -> None:
+        """Serve SLO attainment at ``/slo`` (ISSUE 11): the tracker
+        evaluates on every request. With no ``tracker``, a default one
+        (``slo.default_objectives()``) is built over the attached history
+        ring (or self-feeding from the attached registry)."""
+        if self._httpd is None:
+            self._start(self._storages[0] if self._storages else StatsStorage())
+        handler = self._httpd.RequestHandlerClass
+        if tracker is None:
+            from ..monitoring.history import HistoryView
+            from ..monitoring.slo import SloTracker
+
+            view = None
+            if handler.history_ring is not None or handler.history_dir:
+                # the SAME view /history serves — incl. per-proc ring
+                # spools, so a merged multi-proc server's /slo covers every
+                # proc, not just the local registry
+                view = HistoryView(ring=handler.history_ring,
+                                   directory=handler.history_dir)
+            tracker = SloTracker(history_view=view,
+                                 registry=handler.registry)
+        handler.slo_tracker = tracker
+
+    attachSlo = attach_slo
 
     def attach_model(self, net) -> None:
         """Populate the model tab (C14 model-graph tier): /train/model and

@@ -93,7 +93,8 @@ def _no_leaked_children_or_shm():
 # TDL_METRICS_SPOOL_DIR / TDL_FLIGHT_DIR (or a GangSupervisor workdir) at
 # cwd or the shared tempdir instead of tmp_path leaves these behind for
 # every later test (and CI run) to trip over.
-_OBS_ARTIFACT_PREFIXES = ("tdl_metrics_", "tdl_flight_", "tdl_gang_")
+_OBS_ARTIFACT_PREFIXES = ("tdl_metrics_", "tdl_flight_", "tdl_history_",
+                          "tdl_gang_")
 _OBS_ARTIFACT_NAMES = ("postmortem.json",)
 
 

@@ -59,10 +59,28 @@ def test_rule_validation():
     with pytest.raises(ValueError, match="unknown op"):
         AlertRule("x", "tdl_score", op="!=")
     with pytest.raises(ValueError, match="unknown agg"):
-        AlertRule("x", "tdl_score", agg="p99")
+        AlertRule("x", "tdl_score", agg="median")
+    with pytest.raises(ValueError, match="unknown agg"):
+        AlertRule("x", "tdl_score", agg="p0")  # quantile must be in (0, 100)
     with pytest.raises(ValueError, match="duplicate"):
         AlertEngine(rules=(AlertRule("dup", "tdl_score"),
                            AlertRule("dup", "tdl_score")))
+    # v2 (ISSUE 11) field validation
+    assert AlertRule("q", "tdl_score", agg="p99.9").agg == "p99.9"
+    with pytest.raises(ValueError, match="rate=True needs window"):
+        AlertRule("x", "tdl_score", rate=True)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        AlertRule("x", "tdl_score", window=10, after_warmup=True)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        AlertRule("x", "tdl_score", window=10, ratio_of="tdl_score")
+    with pytest.raises(ValueError, match="window must be > 0"):
+        AlertRule("x", "tdl_score", window=0)
+    with pytest.raises(ValueError, match="for_duration"):
+        AlertRule("x", "tdl_score", for_duration=-1)
+    # label_filter normalizes to a hashable sorted tuple on the frozen rule
+    r = AlertRule("x", "tdl_score", label_filter={"b": 2, "a": "1"})
+    assert r.label_filter == (("a", "1"), ("b", "2"))
+    assert r.label_filter_dict == {"a": "1", "b": "2"}
 
 
 def test_threshold_and_agg_over_series():
@@ -306,6 +324,235 @@ def test_signature_lru_bounds_table_and_counts_evictions():
     finally:
         wd.close()
     assert wd_mod.UNATTRIBUTED == "_unattributed"
+
+
+# ------------------------------------------- alert rules v2 (ISSUE 11)
+
+
+class FakeHistory:
+    """History view with hand-authored samples (controlled timestamps)."""
+
+    def __init__(self, samples):
+        self._samples = samples
+
+    def samples(self, window=None, now=None):
+        return self._samples
+
+
+def _hist_sample(t, reg, proc="local"):
+    return {"t": t, "proc": proc, "snapshot": reg.snapshot()}
+
+
+def test_windowed_p99_rule_reads_history_window_delta():
+    """agg="p99" + window: the quantile comes from the WINDOW's bucket
+    deltas, not the all-time cumulative histogram — old fast traffic
+    outside the window cannot mask a slow last minute."""
+    import time as _time
+
+    now = _time.monotonic()
+    reg = MetricsRegistry()
+    h = reg.histogram("tdl_inference_latency_seconds",
+                      buckets=(0.1, 0.5, 1.0))
+    samples = []
+    for _ in range(1000):  # ancient fast traffic (outside the window)
+        h.observe(0.05)
+    samples.append(_hist_sample(now - 120, reg))
+    samples.append(_hist_sample(now - 50, reg))  # window baseline
+    for _ in range(90):
+        h.observe(0.05)
+    for _ in range(10):
+        h.observe(0.9)  # slow tail INSIDE the window
+    samples.append(_hist_sample(now - 1, reg))
+    rule = AlertRule("p99", "tdl_inference_latency_seconds", ">", 0.2,
+                     agg="p99", window=60)
+    eng = AlertEngine(rules=(rule,), registry=reg,
+                      history_view=FakeHistory(samples))
+    row = eng.evaluate()[0]
+    # window delta: 90@0.05 + 10@0.9 → rank 99 lands in the (0.5, 1.0]
+    # bucket, interpolated 0.5 + 0.5*0.9 = 0.95
+    assert row["value"] == pytest.approx(0.95)
+    assert row["firing"]
+    # all-time p99 over the same registry stays fast (1090 fast vs 10 slow)
+    eng2 = AlertEngine(rules=(
+        AlertRule("p99_all", "tdl_inference_latency_seconds", ">", 0.2,
+                  agg="p99"),), registry=reg)
+    assert eng2.evaluate()[0]["value"] < 0.2
+
+
+def test_windowed_rate_rule_counter_per_second():
+    import time as _time
+
+    now = _time.monotonic()
+    reg = MetricsRegistry()
+    c = reg.counter("tdl_inference_shed_total", labels=("reason",))
+    c.labels("queue_full").inc(100)
+    s0 = _hist_sample(now - 10, reg)
+    c.labels("queue_full").inc(50)  # +50 over 10 seconds → 5/s
+    s1 = _hist_sample(now, reg)
+    eng = AlertEngine(rules=(
+        AlertRule("shed", "tdl_inference_shed_total", ">", 3, agg="sum",
+                  window=60, rate=True),), registry=reg,
+        history_view=FakeHistory([s0, s1]))
+    row = eng.evaluate()[0]
+    assert row["value"] == pytest.approx(5.0, rel=1e-6)
+    assert row["firing"]
+
+
+def test_windowed_rule_series_born_mid_window_counts_from_zero():
+    """A family whose first observation happened inside the window must
+    still produce a windowed value (synthetic zero baseline), not no_data —
+    otherwise the first minute of traffic is invisible to every rule."""
+    import time as _time
+
+    now = _time.monotonic()
+    reg = MetricsRegistry()
+    reg.counter("tdl_inference_shed_total", labels=("reason",))  # no series
+    s0 = _hist_sample(now - 10, reg)
+    reg.get("tdl_inference_shed_total").labels("queue_full").inc(30)
+    s1 = _hist_sample(now, reg)
+    eng = AlertEngine(rules=(
+        AlertRule("shed", "tdl_inference_shed_total", ">", 1, agg="sum",
+                  window=60, rate=True),), registry=reg,
+        history_view=FakeHistory([s0, s1]))
+    row = eng.evaluate()[0]
+    assert row["value"] == pytest.approx(3.0, rel=1e-6)  # 30 over 10s
+
+
+def test_windowed_percentile_over_gauge_is_no_data_not_mean():
+    """A pNN agg needs bucket data; over a gauge family it must report
+    no_data (matching the snapshot path), never silently fold the point
+    samples into a mean that under-reports the tail."""
+    import time as _time
+
+    now = _time.monotonic()
+    reg = MetricsRegistry()
+    g = reg.gauge("tdl_inference_queue_depth")
+    samples = []
+    for t_off, v in ((-30, 0), (-20, 0), (-10, 0), (-1, 100)):
+        g.set(v)
+        samples.append(_hist_sample(now + t_off, reg))
+    eng = AlertEngine(rules=(
+        AlertRule("p99_depth", "tdl_inference_queue_depth", ">", 50,
+                  agg="p99", window=60),), registry=reg,
+        history_view=FakeHistory(samples))
+    row = eng.evaluate()[0]
+    assert row["state"] == "no_data" and row["value"] is None
+
+
+def test_label_filter_restricts_series():
+    reg = MetricsRegistry()
+    g = reg.gauge("tdl_slo_burn_rate", labels=("slo", "window"))
+    g.labels("latency", "fast").set(20.0)
+    g.labels("latency", "slow").set(1.0)
+    eng = AlertEngine(rules=(
+        AlertRule("burn_fast", "tdl_slo_burn_rate", ">", 10, agg="max",
+                  label_filter={"window": "fast"}),
+        AlertRule("burn_slow", "tdl_slo_burn_rate", ">", 10, agg="max",
+                  label_filter={"window": "slow"}),
+    ), registry=reg)
+    by = {a["rule"]: a for a in eng.evaluate()}
+    assert by["burn_fast"]["firing"] and by["burn_fast"]["value"] == 20.0
+    assert not by["burn_slow"]["firing"] and by["burn_slow"]["value"] == 1.0
+
+
+def test_for_duration_requires_consecutive_holds_before_firing():
+    """ISSUE 11 satellite: no fire before for_duration evaluations; a dip
+    resets the count — exactly the anti-flap contract a scaler needs."""
+    reg = MetricsRegistry()
+    g = reg.gauge("tdl_inference_queue_depth")
+    eng = AlertEngine(rules=(
+        AlertRule("hwm", "tdl_inference_queue_depth", ">=", 48,
+                  for_duration=3),), registry=reg)
+    g.set(60)
+    assert eng.evaluate()[0]["state"] == "pending"  # hold 1
+    assert eng.evaluate()[0]["state"] == "pending"  # hold 2
+    g.set(0)
+    assert eng.evaluate()[0]["state"] == "ok"       # dip resets the count
+    g.set(60)
+    states = [eng.evaluate()[0]["state"] for _ in range(3)]
+    assert states == ["pending", "pending", "firing"]
+    fired = reg.get("tdl_alerts_fired_total").labels("hwm").value
+    assert fired == 1  # the two pending runs never fired
+
+
+def test_hysteresis_keeps_one_interval_and_clear_recorded_once():
+    """ISSUE 11 satellite (edge semantics): rising → firing → value dips
+    INSIDE the hysteresis band (stays firing, no second edge) → below the
+    band (alert_clear exactly once, with duration) → back inside the band
+    (does NOT re-fire: clearing direction crossed, rising needs the full
+    threshold again)."""
+    rec = FlightRecorder(proc="hyst-test")
+    flight.set_flight_recorder(rec)
+    try:
+        reg = MetricsRegistry()
+        g = reg.gauge("tdl_inference_queue_depth")
+        eng = AlertEngine(rules=(
+            AlertRule("hwm", "tdl_inference_queue_depth", ">", 50,
+                      clear_hysteresis=10),), registry=reg)
+        g.set(60)
+        assert eng.evaluate()[0]["firing"]       # rising edge
+        g.set(45)                                # inside (40, 50] band
+        assert eng.evaluate()[0]["firing"]       # still ONE interval
+        g.set(35)                                # below threshold - band
+        row = eng.evaluate()[0]
+        assert not row["firing"] and row["state"] == "ok"
+        g.set(45)                                # back inside the band
+        assert not eng.evaluate()[0]["firing"]   # no re-fire inside band
+        g.set(60)
+        assert eng.evaluate()[0]["firing"]       # full threshold re-fires
+
+        fired = reg.get("tdl_alerts_fired_total").labels("hwm").value
+        cleared = reg.get("tdl_alerts_cleared_total").labels("hwm").value
+        assert (fired, cleared) == (2, 1)
+        clears = [e for e in rec.events() if e["kind"] == "alert_clear"]
+        assert len(clears) == 1
+        assert clears[0]["rule"] == "hwm" and clears[0]["duration"] >= 0
+        rises = [e for e in rec.events() if e["kind"] == "alert"]
+        assert len(rises) == 2
+    finally:
+        flight.set_flight_recorder(None)
+
+
+def test_engine_internal_history_feed_gives_windowed_values():
+    """Without an explicit history view, the engine's own evaluations feed
+    the buffer — two scrapes are enough for a windowed rate."""
+    import time as _time
+
+    reg = MetricsRegistry()
+    c = reg.counter("tdl_inference_shed_total", labels=("reason",))
+    c.labels("queue_full").inc(5)
+    eng = AlertEngine(rules=(
+        AlertRule("shed", "tdl_inference_shed_total", ">", 0.0001,
+                  agg="sum", window=60, rate=True),), registry=reg)
+    eng.evaluate()  # sample 1 (dt=0 → no rate yet)
+    c.labels("queue_full").inc(5)
+    _time.sleep(0.05)
+    row = eng.evaluate()[0]  # sample 2: +5 over ~0.05s
+    assert row["value"] is not None and row["value"] > 1
+    assert row["firing"]
+
+
+def test_alert_intervals_pairs_rising_and_falling_edges():
+    from deeplearning4j_tpu.parallel.supervisor import _alert_intervals
+
+    events = [
+        {"kind": "alert", "proc": "rank0", "rule": "p99", "t": 10.0,
+         "severity": "warning"},
+        {"kind": "step_begin", "proc": "rank0", "t": 11.0},
+        {"kind": "alert_clear", "proc": "rank0", "rule": "p99", "t": 14.0,
+         "duration": 4.0, "severity": "warning"},
+        {"kind": "alert", "proc": "rank1", "rule": "burn", "t": 12.0,
+         "severity": "critical"},
+    ]
+    rows = _alert_intervals(events)
+    assert len(rows) == 2
+    still = [r for r in rows if r["still_firing"]][0]
+    assert still["rule"] == "burn" and still["end_t"] is None
+    closed = [r for r in rows if not r["still_firing"]][0]
+    assert closed["rule"] == "p99"
+    assert closed["start_t"] == 10.0 and closed["end_t"] == 14.0
+    assert closed["duration"] == 4.0
+    assert _alert_intervals([{"kind": "step_begin"}]) == []
 
 
 # ---------------------------------------------------- alert-rule AST lint

@@ -1,0 +1,299 @@
+"""Metrics history ring (ISSUE 11 tentpole, layer 1).
+
+Ring semantics (eviction order, throttling), spool + newest-per-proc merge,
+window queries (baselines, born-mid-window zeroing), the shared quantile /
+delta math every windowed consumer uses, and the `/history` endpoint with
+family/label/window filters.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.monitoring import HistoryRing, HistoryView, MetricsRegistry
+from deeplearning4j_tpu.monitoring import history
+
+
+# ------------------------------------------------------------------- ring
+
+
+def test_ring_appends_and_evicts_oldest_first():
+    reg = MetricsRegistry()
+    g = reg.gauge("tdl_test_gauge")
+    ring = HistoryRing(registry=reg, capacity=4, interval=0.0, proc="p0")
+    for i in range(7):
+        g.set(i)
+        assert ring.sample(force=True) is not None
+    assert len(ring) == 4
+    vals = [s["snapshot"]["tdl_test_gauge"]["series"][0]["value"]
+            for s in ring.samples()]
+    # oldest evicted first: the ring holds the LAST four samples, in order
+    assert vals == [3.0, 4.0, 5.0, 6.0]
+    ts = [s["t"] for s in ring.samples()]
+    assert ts == sorted(ts)
+
+
+def test_ring_interval_throttles_and_force_overrides():
+    ring = HistoryRing(registry=MetricsRegistry(), interval=60.0)
+    assert ring.sample() is not None
+    assert ring.sample() is None          # throttled
+    assert ring.sample(force=True) is not None
+    assert len(ring) == 2
+
+
+def test_ring_spool_throttled_separately_from_sampling(tmp_path):
+    """Disk spooling rewrites the whole ring, so it must NOT happen on
+    every in-memory sample — the hot-path hook samples every couple of
+    seconds, the spool rewrites an order of magnitude less often.
+    force=True bypasses both throttles (fault injectors, tests)."""
+    ring = HistoryRing(registry=MetricsRegistry(), interval=0.0,
+                       proc="p0", directory=str(tmp_path),
+                       spool_interval=3600.0)
+    ring.sample()  # first sample: spools (no previous flush)
+    first = history.read_rings(str(tmp_path))[0]
+    assert len(first["samples"]) == 1
+    ring.sample()  # in-memory only: spool throttled
+    assert len(ring) == 2
+    assert len(history.read_rings(str(tmp_path))[0]["samples"]) == 1
+    ring.sample(force=True)  # force bypasses the spool throttle
+    assert len(history.read_rings(str(tmp_path))[0]["samples"]) == 3
+
+
+def test_ring_window_filter():
+    ring = HistoryRing(registry=MetricsRegistry(), interval=0.0)
+    ring.sample(force=True)
+    time.sleep(0.05)
+    ring.sample(force=True)
+    now = time.monotonic()
+    assert len(ring.samples()) == 2
+    assert len(ring.samples(window=0.03, now=now)) == 1
+    assert len(ring.samples(window=10.0, now=now)) == 2
+
+
+# ------------------------------------------------------- spools and merge
+
+
+def test_spool_roundtrip_and_newest_per_proc_dedup(tmp_path):
+    reg = MetricsRegistry()
+    reg.gauge("tdl_test_gauge").set(1)
+    old = HistoryRing(registry=reg, interval=0.0, proc="rank0",
+                      directory=str(tmp_path))
+    old.sample(force=True)
+    time.sleep(0.02)
+    # a "respawned incarnation" under the same proc name, different pid is
+    # simulated by pointing a second ring at the same dir with a tweaked
+    # path via proc — same proc → newest wins
+    newer = HistoryRing(registry=reg, interval=0.0, proc="rank0",
+                        directory=str(tmp_path))
+    # give the two rings distinct files the way distinct pids would
+    newer_path = str(tmp_path / "tdl_history_rank0.999999.json")
+    payload = {"proc": "rank0", "rank": 0, "pid": 999999,
+               "wall": time.time() + 10, "samples": newer.samples()}
+    with open(newer_path, "w") as f:
+        json.dump(payload, f)
+    rings = history.read_rings(str(tmp_path))
+    assert len(rings) == 1  # newest per proc
+    assert rings[0]["pid"] == 999999
+
+    # torn/corrupt/non-dict files are skipped, not raised
+    (tmp_path / "tdl_history_bad.1.json").write_text("{torn")
+    (tmp_path / "tdl_history_list.2.json").write_text("[1, 2]")
+    assert len(history.read_rings(str(tmp_path))) == 1
+
+
+def test_merged_samples_local_ring_wins_over_its_own_spool(tmp_path):
+    reg = MetricsRegistry()
+    ring = HistoryRing(registry=reg, interval=0.0, proc="serve0",
+                       directory=str(tmp_path))
+    ring.sample(force=True)
+    ring.sample(force=True)  # ring spooled itself: same proc on disk
+    other = HistoryRing(registry=MetricsRegistry(), interval=0.0,
+                        proc="rank1", directory=str(tmp_path))
+    other.sample(force=True)
+    merged = history.merged_samples(str(tmp_path), ring)
+    procs = [s["proc"] for s in merged]
+    # serve0 appears exactly twice (from the live ring, NOT double-counted
+    # with its spool), rank1 once from its spool
+    assert procs.count("serve0") == 2 and procs.count("rank1") == 1
+    ts = [s["t"] for s in merged]
+    assert ts == sorted(ts)
+    view = HistoryView(ring=ring, directory=str(tmp_path))
+    assert len(view.samples()) == 3
+
+
+# ------------------------------------------------------------ window math
+
+
+def test_window_points_baseline_and_born_mid_window():
+    snapA = {"tdl_c": {"type": "counter", "series": [
+        {"labels": {"r": "a"}, "value": 10.0}]}}
+    snapB = {"tdl_c": {"type": "counter", "series": [
+        {"labels": {"r": "a"}, "value": 25.0},
+        {"labels": {"r": "b"}, "value": 7.0}]}}
+    samples = [
+        {"t": 0.0, "proc": "p", "snapshot": snapA},    # before the window
+        {"t": 50.0, "proc": "p", "snapshot": snapA},   # window baseline edge
+        {"t": 90.0, "proc": "p", "snapshot": snapB},
+    ]
+    pts = history.window_points(samples, "tdl_c", window=60, now=100.0,
+                                baseline=True)
+    a = pts[("p", (("r", "a"),))]
+    # nearest pre-window point (t=0 is older than t=50? no — t=50 is IN
+    # window [40, 100]; t=0 is the pre-window baseline)
+    assert [t for t, _ in a] == [0.0, 50.0, 90.0]
+    b = pts[("p", (("r", "b"),))]
+    # series b born mid-window: synthetic zero at the earliest in-window
+    # sample time, so its 7 events count
+    assert b[0] == (50.0, {"value": 0.0, "count": 0, "sum": 0.0,
+                           "buckets": {}, "inf": 0})
+    assert history.counter_increase(b[0][1]["value"], b[-1][1]["value"]) == 7.0
+
+
+def test_counter_increase_handles_reset():
+    assert history.counter_increase(10, 25) == 15
+    assert history.counter_increase(100, 30) == 30  # restart: count from 0
+
+
+def test_histogram_delta_and_merge_and_quantile():
+    first = {"count": 100, "sum": 5.0, "buckets": {"0.1": 100, "0.5": 0}, "inf": 0}
+    last = {"count": 130, "sum": 23.0, "buckets": {"0.1": 110, "0.5": 20}, "inf": 0}
+    d = history.histogram_delta(first, last)
+    assert d == {"buckets": {"0.1": 10, "0.5": 20}, "inf": 0,
+                 "sum": 18.0, "count": 30}
+    # restart (count went down) → delta is the whole new histogram
+    reset = history.histogram_delta(last, first)
+    assert reset["count"] == 100 and reset["buckets"]["0.1"] == 100
+
+    merged = history.merge_histograms([d, d])
+    assert merged["count"] == 60 and merged["buckets"]["0.5"] == 40
+
+    # quantile: 10 in (0, 0.1], 20 in (0.1, 0.5] → p50 rank 15 → 5/20 into
+    # the second bucket → 0.1 + 0.4 * 0.25 = 0.2
+    assert history.quantile_from_buckets(d["buckets"], d["inf"], 0.5) \
+        == pytest.approx(0.2)
+    # all mass in +Inf reports the highest finite edge
+    assert history.quantile_from_buckets({"0.1": 0, "0.5": 0}, 5, 0.99) == 0.5
+    assert history.quantile_from_buckets({}, 0, 0.99) is None
+
+
+def test_count_at_or_below_interpolates():
+    buckets = {"0.1": 10, "0.5": 20, "1.0": 0}
+    assert history.count_at_or_below(buckets, 0.1) == 10
+    assert history.count_at_or_below(buckets, 0.5) == 30
+    # halfway through the (0.1, 0.5] bucket → 10 + 20 * 0.5
+    assert history.count_at_or_below(buckets, 0.3) == pytest.approx(20.0)
+    assert history.count_at_or_below(buckets, 2.0) == 30
+
+
+# -------------------------------------------------------- env-driven hook
+
+
+def test_maybe_sample_env_contract(tmp_path, monkeypatch):
+    import importlib
+
+    monkeypatch.setenv(history.ENV_DIR, str(tmp_path))
+    monkeypatch.setenv(history.ENV_INTERVAL, "0")
+    # reset the cached module ring so the new env contract is picked up
+    history._ring = None
+    history._ring_key = None
+    try:
+        history.maybe_sample(force=True)
+        history.maybe_sample(force=True)
+        rings = history.read_rings(str(tmp_path))
+        assert len(rings) == 1
+        assert len(rings[0]["samples"]) == 2
+    finally:
+        history._ring = None
+        history._ring_key = None
+
+
+def test_maybe_spool_drives_history_hook(tmp_path, monkeypatch):
+    """aggregate.maybe_spool is the one hook site every process kind
+    already calls — TDL_HISTORY_DIR alone (no metrics spool dir) must be
+    enough to accrue history."""
+    from deeplearning4j_tpu.monitoring import aggregate
+
+    monkeypatch.delenv(aggregate.ENV_DIR, raising=False)
+    monkeypatch.setenv(history.ENV_DIR, str(tmp_path))
+    monkeypatch.setenv(history.ENV_INTERVAL, "0")
+    history._ring = None
+    history._ring_key = None
+    try:
+        aggregate.maybe_spool(force=True)
+        assert len(history.read_rings(str(tmp_path))) == 1
+    finally:
+        history._ring = None
+        history._ring_key = None
+
+
+# ------------------------------------------------------- /history endpoint
+
+
+def test_history_endpoint_filters(tmp_path):
+    from deeplearning4j_tpu.ui import UIServer
+
+    reg = MetricsRegistry()
+    g = reg.gauge("tdl_test_gauge", labels=("shard",))
+    h = reg.histogram("tdl_test_hist", buckets=(0.1, 1.0))
+    # long interval: the endpoint's per-request sample() is throttled, so
+    # the point series below stays exactly the two forced samples
+    ring = HistoryRing(registry=reg, interval=3600.0, proc="serve0")
+    g.labels("a").set(1)
+    g.labels("b").set(9)
+    h.observe(0.05)
+    ring.sample(force=True)
+    g.labels("a").set(2)
+    ring.sample(force=True)
+
+    server = UIServer(port=0)
+    try:
+        server.attach_registry(reg)
+        server.attach_history(ring)
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}{path}", timeout=10) as r:
+                return json.loads(r.read())
+
+        summary = get("/history")
+        assert summary["procs"] == ["serve0"]
+        assert "tdl_test_gauge" in summary["families"]
+        assert summary["samples"] >= 2
+
+        fam = get("/history?family=tdl_test_gauge&label.shard=a")
+        assert fam["type"] == "gauge"
+        vals = [p["value"] for p in fam["points"]]
+        assert vals == [1.0, 2.0]
+        assert all(p["labels"] == {"shard": "a"} for p in fam["points"])
+        assert all(p["proc"] == "serve0" for p in fam["points"])
+
+        hist = get("/history?family=tdl_test_hist")
+        assert hist["type"] == "histogram"
+        assert all("buckets" in p for p in hist["points"])
+
+        # a tiny window excludes old samples (endpoint samples the ring per
+        # request, so at least the fresh sample is inside)
+        recent = get("/history?family=tdl_test_gauge&window=0.0001")
+        assert len(recent["points"]) <= len(fam["points"])
+
+        none = get("/history?family=tdl_nope")
+        assert none["points"] == [] and none["type"] is None
+    finally:
+        server.stop()
+
+
+def test_history_endpoint_404_without_attachment():
+    from deeplearning4j_tpu.ui import UIServer
+
+    server = UIServer(port=0)
+    try:
+        server.attach_registry(MetricsRegistry())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/history", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        server.stop()

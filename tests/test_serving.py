@@ -616,3 +616,173 @@ def test_request_id_sanitizes_garbage_header():
     assert "\n" not in generated and len(generated) == 16
     assert len(_request_id("x" * 500)) == 16  # over-long → replaced
     assert len(_request_id(None)) == 16
+
+
+# ------------------------------------------- request spans (ISSUE 11)
+
+
+def _install_recorder():
+    from deeplearning4j_tpu.monitoring import flight
+    from deeplearning4j_tpu.monitoring.flight import FlightRecorder
+
+    rec = FlightRecorder(proc="span-test", capacity=4096)
+    flight.set_flight_recorder(rec)
+    return rec
+
+
+def _clear_recorder():
+    from deeplearning4j_tpu.monitoring import flight
+
+    flight.set_flight_recorder(None)
+
+
+def _spans(rec, rid=None):
+    return [e for e in rec.events() if e["kind"] == "request_span"
+            and (rid is None or e.get("request_id") == rid)]
+
+
+def test_request_span_for_200_carries_full_phase_timeline():
+    """ISSUE 11: a sampled 200's life — queue → batch_form → infer →
+    serialize — reconstructs from ONE flight event joined by request id."""
+    rec = _install_recorder()
+    server = JsonModelServer(SlowModel(), registry=MetricsRegistry()).start()
+    try:
+        body = json.dumps([[1.0, 2.0, 3.0, 4.0]]).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "span-ok-1"})
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            assert resp.status == 200
+        spans = _spans(rec, "span-ok-1")
+        assert len(spans) == 1
+        ev = spans[0]
+        assert ev["outcome"] == "ok" and ev["code"] == 200
+        assert set(ev["phases"]) == {"queue", "batch_form", "infer",
+                                     "serialize"}
+        assert all(v >= 0 for v in ev["phases"].values())
+        assert ev["batch_rows"] >= 1
+    finally:
+        server.stop()
+        _clear_recorder()
+
+
+def test_request_span_for_shed_queue_full_and_expired_deadline():
+    """ISSUE 11 satellite: a 429 and an expired-in-queue 504 leave spans
+    too (outcome=shed_queue_full / shed_deadline) — an error's timeline is
+    as reconstructable as a 200's."""
+    rec = _install_recorder()
+    model = SlowModel(delay=0.4)
+    server = JsonModelServer(model, max_queue=1,
+                             registry=MetricsRegistry()).start()
+    try:
+        ok = json.dumps([[1.0, 2.0, 3.0, 4.0]]).encode()
+
+        def fire(rid, deadline_ms=None):
+            headers = {"Content-Type": "application/json",
+                       "X-Request-Id": rid}
+            if deadline_ms:
+                headers["X-Deadline-Ms"] = str(deadline_ms)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/predict", data=ok,
+                headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+
+        t1 = threading.Thread(target=fire, args=("span-first",))
+        t1.start()
+        assert model.started.wait(5.0)  # first request inside the model
+        # occupies the single queue slot, with a deadline shorter than the
+        # in-flight forward → expires IN QUEUE
+        t2 = threading.Thread(target=fire, args=("span-expired", 100))
+        t2.start()
+        time.sleep(0.1)
+        # queue full now → shed at admission
+        assert fire("span-full") == 429
+        t1.join(30.0)
+        t2.join(30.0)
+        server.stop(drain=True)
+
+        full = _spans(rec, "span-full")
+        assert len(full) == 1 and full[0]["outcome"] == "shed_queue_full"
+        expired = _spans(rec, "span-expired")
+        assert len(expired) == 1
+        assert expired[0]["outcome"] == "shed_deadline"
+        assert expired[0]["phases"]["queue"] >= 0.1  # its life WAS the queue
+        ok_span = _spans(rec, "span-first")
+        assert len(ok_span) == 1 and ok_span[0]["outcome"] == "ok"
+    finally:
+        server.stop()
+        _clear_recorder()
+
+
+def test_span_sampling_is_deterministic_by_request_id():
+    from deeplearning4j_tpu.monitoring import flight
+    from deeplearning4j_tpu.monitoring.flight import FlightRecorder
+    from deeplearning4j_tpu.serving.executor import span_sampled
+
+    # inactive flight recording → never sampled (hot path pays one lookup)
+    assert not span_sampled("abc", 1)
+    rec = FlightRecorder(proc="sample-test")
+    flight.set_flight_recorder(rec)
+    try:
+        assert span_sampled("abc", 1)
+        assert span_sampled(None, 1)
+        # deterministic: same id, same verdict, every call
+        verdicts = {rid: span_sampled(rid, 4) for rid in
+                    (f"r{i}" for i in range(64))}
+        assert verdicts == {rid: span_sampled(rid, 4) for rid in verdicts}
+        kept = sum(verdicts.values())
+        assert 0 < kept < 64  # ~1/4 sampled
+        assert not span_sampled(None, 4)  # no id → no joinable timeline
+    finally:
+        flight.set_flight_recorder(None)
+
+
+# ------------------------------------------- client metrics (ISSUE 11)
+
+
+def test_client_metrics_record_outcomes_and_retries():
+    reg = MetricsRegistry()
+    model = FlakyModel(fail_first=2)
+    server = JsonModelServer(model).start()
+    try:
+        client = JsonModelClient(port=server.port, retries=4,
+                                 backoff_base=0.01, backoff_max=0.05,
+                                 registry=reg)
+        client.predict([[1.0, 2.0, 3.0, 4.0]])  # two 500s then success
+        hist = reg.get("tdl_client_request_seconds").snapshot()["series"]
+        by_outcome = {s["labels"]["outcome"]: s["count"] for s in hist}
+        assert by_outcome == {"ok": 1}  # ONE request from the caller's view
+        retries = _counter_values(reg, "tdl_client_retries_total")
+        assert retries[("http_500",)] == 2
+
+        with pytest.raises(RuntimeError, match="400"):
+            client.predict(["not", "numbers"])
+        by_outcome = {s["labels"]["outcome"]: s["count"]
+                      for s in reg.get("tdl_client_request_seconds")
+                      .snapshot()["series"]}
+        assert by_outcome == {"ok": 1, "bad_request": 1}
+    finally:
+        server.stop()
+
+
+def test_client_metrics_connection_and_breaker_outcomes():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    reg = MetricsRegistry()
+    client = JsonModelClient(port=dead_port, retries=0, breaker_threshold=1,
+                             breaker_cooldown=30.0, registry=reg)
+    with pytest.raises(RuntimeError):
+        client.predict([[1.0]])
+    with pytest.raises(RuntimeError, match="circuit breaker open"):
+        client.predict([[1.0]])
+    by_outcome = {s["labels"]["outcome"]: s["count"]
+                  for s in reg.get("tdl_client_request_seconds")
+                  .snapshot()["series"]}
+    assert by_outcome == {"connection": 1, "breaker_open": 1}

@@ -1,0 +1,311 @@
+"""SLO objectives + tracker (ISSUE 11 tentpole, layer 3).
+
+Objective validation, attainment math (latency histograms with bucket
+interpolation, success ratios with label-prefix goodness), error-budget and
+burn-rate arithmetic, gauge export, the /slo endpoint, and the AST lint
+pinning every library SloObjective to a registry-declared family.
+"""
+
+import ast
+import json
+import pathlib
+import re
+import time
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.monitoring import (HistoryRing, MetricsRegistry,
+                                           SloObjective, SloTracker,
+                                           default_objectives)
+from deeplearning4j_tpu.monitoring import aggregate
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# -------------------------------------------------------------- validation
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="exactly one of"):
+        SloObjective("x")
+    with pytest.raises(ValueError, match="exactly one of"):
+        SloObjective("x", histogram_family="tdl_inference_latency_seconds",
+                     success_ratio_of="tdl_inference_requests_total")
+    with pytest.raises(ValueError, match="threshold_seconds"):
+        SloObjective("x", histogram_family="tdl_inference_latency_seconds")
+    with pytest.raises(ValueError, match="target must be in"):
+        SloObjective("x", success_ratio_of="tdl_inference_requests_total",
+                     target=1.0)
+    with pytest.raises(ValueError, match="window must be"):
+        SloObjective("x", success_ratio_of="tdl_inference_requests_total",
+                     window=0)
+    with pytest.raises(ValueError, match="duplicate"):
+        SloTracker(objectives=(
+            SloObjective("d", success_ratio_of="tdl_inference_requests_total"),
+            SloObjective("d", success_ratio_of="tdl_inference_requests_total")))
+    # a success-ratio objective defaults goodness to HTTP 2xx
+    obj = SloObjective("a", success_ratio_of="tdl_inference_requests_total")
+    assert obj.good_labels_dict == {"code": "2"}
+    assert obj.family == "tdl_inference_requests_total"
+
+
+# ------------------------------------------------------------- attainment
+
+
+def _fed_ring(reg):
+    ring = HistoryRing(registry=reg, interval=0.0)
+    ring.sample(force=True)
+    return ring
+
+
+def test_latency_objective_attainment_budget_and_burn():
+    reg = MetricsRegistry()
+    h = reg.histogram("tdl_inference_latency_seconds", buckets=(0.1, 0.5, 1.0))
+    ring = _fed_ring(reg)
+    for _ in range(95):
+        h.observe(0.05)   # good
+    for _ in range(5):
+        h.observe(0.9)    # bad (over the 0.1s threshold)
+    time.sleep(0.01)
+    ring.sample(force=True)
+    tracker = SloTracker(objectives=(
+        SloObjective("lat", histogram_family="tdl_inference_latency_seconds",
+                     threshold_seconds=0.1, target=0.9, window=60),),
+        history_view=ring, registry=reg,
+        burn_windows=(("fast", 60.0),))
+    row = tracker.evaluate()[0]
+    assert row["attainment"] == pytest.approx(0.95)
+    # allowed error 0.1, observed 0.05 → half the budget consumed
+    assert row["error_budget_remaining"] == pytest.approx(0.5)
+    assert row["burn_rate"]["fast"] == pytest.approx(0.5)
+    assert row["state"] == "ok"
+
+    gauges = {s["labels"]["slo"]: s["value"]
+              for s in reg.get("tdl_slo_attainment").snapshot()["series"]}
+    assert gauges["lat"] == pytest.approx(0.95)
+    burn = {(s["labels"]["slo"], s["labels"]["window"]): s["value"]
+            for s in reg.get("tdl_slo_burn_rate").snapshot()["series"]}
+    assert burn[("lat", "fast")] == pytest.approx(0.5)
+
+
+def test_latency_objective_interpolates_inside_threshold_bucket():
+    """A threshold between bucket edges counts the containing bucket's
+    observations proportionally — same interpolation as the p99 rules."""
+    reg = MetricsRegistry()
+    h = reg.histogram("tdl_inference_latency_seconds", buckets=(0.1, 0.5))
+    ring = _fed_ring(reg)
+    for _ in range(100):
+        h.observe(0.3)  # all in the (0.1, 0.5] bucket
+    time.sleep(0.01)
+    ring.sample(force=True)
+    tracker = SloTracker(objectives=(
+        SloObjective("lat", histogram_family="tdl_inference_latency_seconds",
+                     threshold_seconds=0.3, target=0.9, window=60),),
+        history_view=ring, registry=reg, burn_windows=())
+    row = tracker.evaluate()[0]
+    # (0.3 - 0.1) / (0.5 - 0.1) = half the bucket counts as good
+    assert row["attainment"] == pytest.approx(0.5)
+    assert row["state"] == "violating"
+
+
+def test_success_ratio_objective_prefix_goodness():
+    reg = MetricsRegistry()
+    c = reg.counter("tdl_inference_requests_total", labels=("code",))
+    ring = _fed_ring(reg)
+    c.labels("200").inc(90)
+    c.labels("201").inc(5)   # also 2xx-good
+    c.labels("429").inc(4)
+    c.labels("504").inc(1)
+    time.sleep(0.01)
+    ring.sample(force=True)
+    tracker = SloTracker(objectives=(
+        SloObjective("avail", success_ratio_of="tdl_inference_requests_total",
+                     target=0.9, window=60),),
+        history_view=ring, registry=reg, burn_windows=())
+    row = tracker.evaluate()[0]
+    assert row["attainment"] == pytest.approx(0.95)
+    assert row["error_budget_remaining"] == pytest.approx(0.5)
+
+
+def test_no_traffic_reports_full_budget_not_outage():
+    reg = MetricsRegistry()
+    reg.histogram("tdl_inference_latency_seconds", buckets=(0.1,))
+    ring = _fed_ring(reg)
+    ring.sample(force=True)
+    tracker = SloTracker(objectives=(
+        SloObjective("lat", histogram_family="tdl_inference_latency_seconds",
+                     threshold_seconds=0.1, target=0.99, window=60),),
+        history_view=ring, registry=reg)
+    row = tracker.evaluate()[0]
+    assert row["state"] == "no_traffic"
+    assert row["attainment"] is None
+    assert row["error_budget_remaining"] == 1.0
+    assert all(b == 0.0 for b in row["burn_rate"].values())
+    # the gauge encodes no-traffic as -1, never 0.0 (0 reads as an outage)
+    assert reg.get("tdl_slo_attainment").labels("lat").value == -1.0
+
+
+def test_tracker_self_feeds_without_history_view():
+    reg = MetricsRegistry()
+    c = reg.counter("tdl_inference_requests_total", labels=("code",))
+    tracker = SloTracker(objectives=(
+        SloObjective("avail", success_ratio_of="tdl_inference_requests_total",
+                     target=0.9, window=60),),
+        registry=reg, burn_windows=())
+    c.labels("200").inc(1)
+    assert tracker.evaluate()[0]["state"] == "no_traffic"  # one sample: no delta
+    c.labels("200").inc(9)
+    c.labels("500").inc(10)
+    time.sleep(0.01)
+    row = tracker.evaluate()[0]
+    assert row["attainment"] == pytest.approx(9 / 19)
+    assert row["state"] == "violating"
+
+
+# ------------------------------------------------------------ /slo endpoint
+
+
+def test_slo_endpoint_serves_tracker():
+    from deeplearning4j_tpu.ui import UIServer
+
+    reg = MetricsRegistry()
+    h = reg.histogram("tdl_inference_latency_seconds", buckets=(0.1, 0.5))
+    ring = HistoryRing(registry=reg, interval=0.0)
+    ring.sample(force=True)
+    for _ in range(10):
+        h.observe(0.05)
+    for _ in range(10):
+        h.observe(0.4)
+    time.sleep(0.01)
+    ring.sample(force=True)
+    tracker = SloTracker(objectives=(
+        SloObjective("lat", histogram_family="tdl_inference_latency_seconds",
+                     threshold_seconds=0.1, target=0.99, window=60),),
+        history_view=ring, registry=reg)
+    server = UIServer(port=0)
+    try:
+        server.attach_registry(reg)
+        server.attach_slo(tracker)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/slo", timeout=10) as r:
+            payload = json.loads(r.read())
+        assert payload["violating"] == ["lat"]
+        row = payload["slos"][0]
+        assert row["slo"] == "lat"
+        assert row["attainment"] == pytest.approx(0.5)
+        assert "burn_rate" in row and "error_budget_remaining" in row
+    finally:
+        server.stop()
+
+
+# --------------------------------------------- spool robustness (satellite)
+
+
+def test_read_spools_skips_corrupt_files_and_counts_errors(tmp_path):
+    from deeplearning4j_tpu.monitoring import get_registry
+    from deeplearning4j_tpu.monitoring.aggregate import (MetricsSpooler,
+                                                         read_spools)
+
+    reg = MetricsRegistry()
+    reg.gauge("tdl_test_gauge").set(3)
+    MetricsSpooler(str(tmp_path), proc="rank0", registry=reg,
+                   interval=0.0, rank=0).spool(force=True)
+    # a torn write, a non-object payload, and an object with a bogus
+    # snapshot — each must degrade that file only, never the scrape
+    (tmp_path / "tdl_metrics_rank1.123.json").write_text('{"proc": "rank1", ')
+    (tmp_path / "tdl_metrics_rank2.124.json").write_text("[1, 2, 3]")
+    (tmp_path / "tdl_metrics_rank3.125.json").write_text(
+        json.dumps({"proc": "rank3", "wall": 1, "snapshot": "not-a-dict"}))
+
+    before = {s["labels"]["proc"]: s["value"] for s in
+              (get_registry().get("tdl_spool_read_errors_total") or
+               aggregate.spool_read_errors()).snapshot()["series"]}
+    spools = read_spools(str(tmp_path))
+    assert [s["proc"] for s in spools] == ["rank0"]  # the good spool survives
+    after = {s["labels"]["proc"]: s["value"] for s in
+             get_registry().get("tdl_spool_read_errors_total")
+             .snapshot()["series"]}
+    assert after.get("rank1", 0) - before.get("rank1", 0) == 1
+    assert after.get("rank3", 0) - before.get("rank3", 0) == 1
+    # the non-object file has no proc field; its filename gives rank2
+    assert (after.get("rank2", 0) + after.get("unknown", 0)) \
+        - (before.get("rank2", 0) + before.get("unknown", 0)) == 1
+
+    # and the merged exposition still renders (the original bug class:
+    # one corrupt file poisoning the whole merged /metrics view)
+    text = aggregate.merged_prometheus(str(tmp_path))
+    assert 'tdl_test_gauge{proc="rank0",rank="0"} 3' in text
+
+
+# --------------------------------------------------------------- AST lint
+
+
+def _declared_families() -> set:
+    decl = re.compile(
+        r'\.(?:counter|gauge|histogram)\(\s*["\'](tdl_[a-z0-9_]+)["\']')
+    declared = set(aggregate.DERIVED_FAMILIES)
+    for path in sorted((ROOT / "deeplearning4j_tpu").rglob("*.py")):
+        declared.update(decl.findall(path.read_text()))
+    return declared
+
+
+def test_slo_objectives_reference_declared_histograms():
+    """Repo lint (ISSUE 11 satellite, mirror of the alert-rule lint): every
+    SloObjective(...) in library code must name its family
+    (histogram_family / success_ratio_of) as a LITERAL declared by some
+    registry — renaming a metric fails the build instead of silently
+    rotting the SLO that watches it."""
+    declared = _declared_families()
+    assert len(declared) > 30
+    offenders, found = [], 0
+    for path in sorted((ROOT / "deeplearning4j_tpu").rglob("*.py")):
+        rel = path.relative_to(ROOT).as_posix()
+        tree = ast.parse(path.read_text(), filename=rel)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and ((isinstance(node.func, ast.Name)
+                          and node.func.id == "SloObjective")
+                         or (isinstance(node.func, ast.Attribute)
+                             and node.func.attr == "SloObjective"))):
+                continue
+            found += 1
+            refs = {}
+            for kw in node.keywords:
+                if kw.arg in ("histogram_family", "success_ratio_of"):
+                    refs[kw.arg] = kw.value
+            if not refs:
+                offenders.append(f"{rel}:{node.lineno} (no family argument)")
+                continue
+            for role, val in refs.items():
+                if not (isinstance(val, ast.Constant)
+                        and isinstance(val.value, str)):
+                    if isinstance(val, ast.Constant) and val.value is None:
+                        continue
+                    offenders.append(
+                        f"{rel}:{node.lineno} ({role} is not a string literal)")
+                elif val.value not in declared:
+                    offenders.append(
+                        f"{rel}:{node.lineno} ({role}={val.value!r} is not a "
+                        "registry-declared family)")
+    assert found >= 3  # the scan saw default_objectives()
+    assert not offenders, (
+        "SLO objectives referencing unknown metric families (declare the "
+        f"family in a registry, or fix the objective): {offenders}")
+
+
+def test_default_objectives_compile_against_default_rules():
+    """The stock burn alert rules watch the families the stock tracker
+    exports — the pairing must construct without wiring errors."""
+    from deeplearning4j_tpu.monitoring import AlertEngine, default_rules
+
+    reg = MetricsRegistry()
+    tracker = SloTracker(default_objectives(), registry=reg)
+    engine = AlertEngine(default_rules(), registry=reg)
+    tracker.evaluate()
+    rows = {a["rule"]: a for a in engine.evaluate()}
+    # burn gauges exist (tracker exported them) → the rules see numbers,
+    # zero on a clean registry → not firing
+    assert rows["error_budget_burn_fast"]["value"] == 0.0
+    assert not rows["error_budget_burn_fast"]["firing"]
+    assert rows["error_budget_burn_slow"]["value"] == 0.0
