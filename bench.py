@@ -165,6 +165,10 @@ def _scale(on_tpu):
                                 slo_target=0.99),
             "bert_large_fsdp": dict(batch=8, seq=128, steps=8, warmup=2,
                                     large=True, tp=1),
+            "compile_cache": dict(features=64, classes=8, batch_limit=16,
+                                  max_rows=128, fit_batch=128, fit_steps=4,
+                                  flash=dict(B=1, H=12, T=8192, D=64,
+                                             trials=3)),
         }
     return {
         "resnet50": dict(batch=8, hw=64, classes=10, steps=5, warmup=2, pipeline_steps=3),
@@ -180,6 +184,9 @@ def _scale(on_tpu):
                             slo_target=0.99),
         "bert_large_fsdp": dict(batch=2, seq=64, steps=2, warmup=1,
                                 large=False, tp=1),
+        "compile_cache": dict(features=16, classes=4, batch_limit=8,
+                              max_rows=32, fit_batch=32, fit_steps=2,
+                              flash=dict(B=1, H=2, T=128, D=16, trials=1)),
     }
 
 
@@ -1120,9 +1127,156 @@ def _baseline_ratio(backend, value, config):
     return 1.0
 
 
+# ------------------------------------------------------- compile cache
+
+
+def bench_compile_cache(p):
+    """ISSUE 12: cold-vs-warm executable restore through the persistent
+    compile cache, for the two restart paths that used to re-pay full XLA
+    compilation — serving warmup (a respawned replica warming its whole
+    ParallelInference bucket ladder) and a gang respawn's fit loop — plus
+    the Pallas autotune table (deterministic interpret fallback on the CPU
+    smoke; measured search + measured-roofline utilization on TPU).
+
+    "Warm" here = jax's in-memory caches dropped (``jax.clear_caches``) but
+    the on-disk executable cache intact — the same state a fresh process
+    sharing TDL_COMPILE_CACHE_DIR starts in (the cross-process form is
+    pinned by tests/test_compile_cache.py). Runs LAST in the bench so the
+    cache config never perturbs the other configs' windows."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.common import compile_cache
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.kernels import autotune, flash_attention
+    from deeplearning4j_tpu.monitoring import compilecache
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving.executor import BatchingInferenceExecutor
+
+    def build_net():
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=p["features"], n_out=128,
+                                  activation="relu"))
+                .layer(OutputLayer(n_out=p["classes"], activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    warmed = {}
+
+    def warmup_wall():
+        pi = ParallelInference(build_net(), batch_limit=p["batch_limit"])
+        ex = BatchingInferenceExecutor(
+            parallel_inference=pi,
+            max_batch_rows=p["max_rows"],
+            warmup_input=np.zeros((1, p["features"]), np.float32),
+            warmup_all_buckets=True)
+        t0 = time.perf_counter()
+        ex.start()
+        ex.wait_warm(600)
+        wall = time.perf_counter() - t0
+        ex.stop()
+        warmed["buckets"] = len(pi.bucket_sizes(p["max_rows"]))
+        return wall
+
+    def fit_wall():
+        rs = np.random.RandomState(0)
+        X = rs.randn(p["fit_batch"], p["features"]).astype(np.float32)
+        Y = np.eye(p["classes"], dtype=np.float32)[
+            rs.randint(0, p["classes"], p["fit_batch"])]
+        net = build_net()
+        t0 = time.perf_counter()
+        for _ in range(p["fit_steps"]):
+            net._fit_batch(DataSet(X, Y))
+        float(net.score_)  # drain the dispatch
+        return time.perf_counter() - t0
+
+    out = {"metric": "compile_cache_warm_speedup", "unit": "x"}
+    with tempfile.TemporaryDirectory() as d:
+        compile_cache.enable(os.path.join(d, "cc"))
+        autotune.reset_table()
+        try:
+            serving_cold = warmup_wall()
+            fit_cold = fit_wall()
+            jax.clear_caches()  # the respawned-process state (disk intact)
+            serving_warm = warmup_wall()
+            fit_warm = fit_wall()
+            stats = compilecache.stats()
+            out["serving_warmup"] = {
+                "cold_start_s": round(serving_cold, 3),
+                "warm_start_s": round(serving_warm, 3),
+                "speedup": round(serving_cold / serving_warm, 2)
+                if serving_warm else None,
+                "buckets_warmed": warmed["buckets"],
+            }
+            out["gang_respawn_fit"] = {
+                "cold_start_s": round(fit_cold, 3),
+                "warm_start_s": round(fit_warm, 3),
+                "speedup": round(fit_cold / fit_warm, 2) if fit_warm else None,
+            }
+            out["cache"] = {"hits": round(sum(stats["hits"].values())),
+                            "misses": round(sum(stats["misses"].values())),
+                            "bytes": stats["bytes"]}
+            out["value"] = (round(fit_cold / fit_warm, 2)
+                            if fit_warm else 0.0)
+
+            # ---- autotune: the table persists NEXT TO the executable cache
+            fa = p["flash"]
+            on_tpu = jax.default_backend() == "tpu"
+            table = autotune.get_table(refresh=True)
+            entry = autotune.autotune_flash_attention(
+                fa["B"], fa["H"], fa["T"], fa["D"],
+                jnp.bfloat16 if on_tpu else jnp.float32,
+                trials=fa["trials"], table=table)
+            at_block = {"grid_point": {k: fa[k] for k in ("B", "H", "T", "D")},
+                        "entry": entry, "table_path": table.path,
+                        # the consult path flash_attention takes (proves the
+                        # persisted entry answers; feeds the lookup counter)
+                        "resolved": autotune.resolve_blocks(
+                            "flash_attention", B=fa["B"], H=fa["H"],
+                            Tq=fa["T"], Tk=fa["T"], D=fa["D"],
+                            dtype="bfloat16" if on_tpu else "float32",
+                            table=table)}
+            if on_tpu and entry.get("measured"):
+                # validate the winner against THIS window's measured
+                # roofline (ISSUE 10 discipline): attention flops over the
+                # tuned fwd+bwd wall, honest in utilization terms
+                roofline = _roofline_probe()
+                q = jnp.zeros((fa["B"], fa["H"], fa["T"], fa["D"]),
+                              jnp.bfloat16)
+
+                def run():
+                    return flash_attention(
+                        q, q, q, block_q=entry["block_q"],
+                        block_k=entry["block_k"])
+
+                run().block_until_ready()
+                t0 = time.perf_counter()
+                run().block_until_ready()
+                dt = time.perf_counter() - t0
+                fwd_flops = 4.0 * fa["B"] * fa["H"] * fa["T"] ** 2 * fa["D"]
+                at_block["forward"] = _utilization(fwd_flops, 1, dt, roofline)
+                static_us = entry.get("static_us")
+                if static_us and entry.get("best_us"):
+                    at_block["vs_static"] = round(
+                        static_us / entry["best_us"], 2)
+            out["autotune"] = at_block
+        finally:
+            compile_cache.disable()
+            autotune.reset_table()
+    return out
+
+
 BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "w2v": bench_w2v, "bert": bench_bert, "serving": bench_serving,
-           "serving_slo": bench_serving_slo, "bert_large_fsdp": bench_fsdp}
+           "serving_slo": bench_serving_slo, "bert_large_fsdp": bench_fsdp,
+           "compile_cache": bench_compile_cache}
 
 
 # -------------------------------------------------------- regression compare

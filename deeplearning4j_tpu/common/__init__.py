@@ -14,6 +14,10 @@ _EXPORTS = {
     "to_jax": ".dtypes",
     "from_jax": ".dtypes",
     "Environment": ".environment",
+    "BucketSpec": ".bucketing",
+    "bucket_size": ".bucketing",
+    "bucket_ladder": ".bucketing",
+    "pad_dataset": ".bucketing",
 }
 
 __all__ = list(_EXPORTS)

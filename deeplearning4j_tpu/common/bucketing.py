@@ -1,0 +1,261 @@
+"""Framework-wide shape bucketing (ISSUE 12 tentpole layer 1).
+
+One bucket policy for the whole framework: the power-of-2 padding the
+serving executor has used since ISSUE 5 (``ParallelInference._bucket``),
+extracted here so the training/eval fit paths can stop minting a fresh XLA
+signature for every ragged final batch or odd sequence length. A shape that
+hits the same bucket hits the same compiled executable — with the
+persistent compile cache (``common.compile_cache``) that holds across
+process restarts too.
+
+Correctness contract: padding must be *invisible* to the training math.
+``pad_dataset`` therefore always pairs padded rows/timesteps with zeroed
+mask entries, and the loss layer's masked mean (``nn.losses
+._per_example_mean``: ``sum(per_unit * m) / sum(m)``) divides by the TRUE
+example count — so a batch of 17 padded to 32 produces bit-identical loss
+and gradients to the unpadded batch (pinned to 1e-6 in
+tests/test_bucketing.py). Fit loops that pad also report the true count as
+``last_batch_size`` so samples/sec listeners never see phantom rows.
+
+The one construct the mask CANNOT protect is BatchNormalization: BN batch
+statistics are computed over every row of the padded batch, so phantom
+zero rows would silently change training — ``set_bucketing`` refuses nets
+with BN layers rather than break the parity contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def bucket_size(n: int, *, min_bucket: int = 1, multiple: int = 1) -> int:
+    """Smallest power-of-2 multiple of ``multiple`` that is >= ``n``, seeded
+    at ``min_bucket`` so tiny inputs share one executable.
+
+    This IS the serving bucket policy (``ParallelInference._bucket``):
+    ``multiple`` is the mesh data-axis size there (every bucket stays
+    device-divisible), ``min_bucket`` its ``batch_limit``.
+    """
+    if n < 0:
+        raise ValueError(f"bucket_size needs n >= 0, got {n}")
+    b = max(1, multiple)
+    while b < min_bucket:
+        b *= 2
+    while b < n:
+        b *= 2
+    return b
+
+
+def bucket_ladder(max_n: int, *, min_bucket: int = 1,
+                  multiple: int = 1) -> List[int]:
+    """Every bucket the policy can produce up to ``bucket_size(max_n)``,
+    smallest first — the serving executor pre-warms exactly this ladder so
+    the first large-batch request never pays a compile (ISSUE 12
+    satellite; cheap when the executables restore from the compile cache).
+    """
+    top = bucket_size(max_n, min_bucket=min_bucket, multiple=multiple)
+    b = bucket_size(1, min_bucket=min_bucket, multiple=multiple)
+    ladder = [b]
+    while b < top:
+        b *= 2
+        ladder.append(b)
+    return ladder
+
+
+@dataclass(frozen=True)
+class BucketSpec:
+    """Pad-to-bucket policy for the training/eval fit paths.
+
+    - ``batch``: pad the leading (example) dim of every features/labels
+      array to ``bucket_size(B, min_bucket=min_batch, multiple=
+      batch_multiple)``. ``batch_multiple`` is the mesh data-axis size on
+      parallel trainers (a bucket that keeps the remainder-fallback path
+      dead).
+    - ``sequence``: additionally pad the trailing time dim of rank-3
+      recurrent batches ([B, C, T] layout) to ``bucket_size(T,
+      min_bucket=min_seq)``. Requires a ``labels_mask`` when labels are
+      time-distributed — inventing a mask where none existed would change
+      the loss denominator from per-example to per-timestep and silently
+      break parity with unbucketed training, so that case raises instead.
+    """
+
+    batch: bool = True
+    sequence: bool = False
+    min_batch: int = 1
+    batch_multiple: int = 1
+    min_seq: int = 1
+
+    def batch_bucket(self, n: int) -> int:
+        return bucket_size(n, min_bucket=self.min_batch,
+                           multiple=self.batch_multiple)
+
+    def seq_bucket(self, t: int) -> int:
+        return bucket_size(t, min_bucket=self.min_seq)
+
+
+def _pad_rows_counter():
+    from ..monitoring.registry import get_registry
+
+    return get_registry().counter(
+        "tdl_bucket_pad_rows_total",
+        "Phantom rows added by pad-to-bucket in the fit paths — high "
+        "relative to real rows means the bucket floor is too coarse",
+        labels=("path",))
+
+
+def _xp(a):
+    """numpy for host arrays, jnp for device-resident ones (padding a
+    prefetched device batch must not round-trip d2h)."""
+    if isinstance(a, np.ndarray):
+        return np
+    import jax
+
+    if isinstance(a, jax.Array):
+        import jax.numpy as jnp
+
+        return jnp
+    return np
+
+
+def _pad_axis(a, axis: int, pad: int):
+    if a is None or pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return _xp(a).pad(a, widths)
+
+
+def _ones_like_mask(a, shape):
+    xp = _xp(a)
+    return xp.ones(shape, dtype=np.float32)
+
+
+def pad_batch_dim(arr, bucket: int):
+    """Pad ``arr``'s leading dim with zero rows up to ``bucket``."""
+    if arr is None:
+        return None
+    n = int(arr.shape[0])
+    return _pad_axis(arr, 0, bucket - n)
+
+
+def pad_dataset(ds, spec: BucketSpec):
+    """Pad one DataSet to its (batch, sequence) buckets, masking the
+    padding out of the loss. Returns ``(padded_ds, true_examples)``.
+
+    Masked-loss correctness: padded ROWS get ``labels_mask = 0`` (created
+    as a per-example [B] mask when the dataset had none), and padded
+    TIMESTEPS extend an existing [B, T] mask with zeros — either way the
+    loss's masked mean divides by the true count, see module docstring.
+
+    Signature stability: a batch that happens to be bucket-aligned STILL
+    gets the masks padding would have created (an all-ones mask — the
+    masked mean of all-ones equals the plain mean, so loss is unchanged).
+    Otherwise the jit signature would flicker between mask-less aligned
+    batches and masked padded ones, minting two executables for one
+    workload — the exact churn bucketing exists to kill.
+    """
+    from ..data.dataset import DataSet
+
+    features = ds.features
+    labels = ds.labels
+    fmask = ds.features_mask
+    lmask = ds.labels_mask
+    n = int(features.shape[0])
+    target_b = spec.batch_bucket(n) if spec.batch else n
+    pad_b = target_b - n
+
+    t = int(features.shape[-1]) if features.ndim == 3 else None
+    seq_active = spec.sequence and t is not None
+    target_t = spec.seq_bucket(t) if seq_active else t
+    pad_t = (target_t - t) if t is not None else 0
+    labels_time_distributed = labels is not None and labels.ndim == 3
+
+    changed = False
+    if spec.batch and lmask is None and not (
+            labels_time_distributed and pad_t):
+        # per-example [B] mask: ones for real rows, zeros for padding — the
+        # loss's masked mean then equals the unbucketed mean (the tbptt
+        # path broadcasts it to its per-timestep [B, T] form)
+        lmask = _ones_like_mask(labels if labels is not None else features,
+                                (n,))
+        changed = True
+
+    if seq_active:
+        if labels_time_distributed and ds.labels_mask is None and pad_t:
+            raise ValueError(
+                "sequence bucketing needs a labels_mask when labels are "
+                "time-distributed — inventing one would change the loss "
+                "from a per-example to a per-timestep mean (no parity "
+                "with unbucketed training); provide the mask or use "
+                "BucketSpec(sequence=False)")
+        # padded timesteps must be invisible to time-aware reductions
+        # (LastTimeStep / GlobalPooling read fmask): materialize an
+        # all-ones features mask before (possibly) extending it with zeros
+        if fmask is None:
+            fmask = _ones_like_mask(features, (n, t))
+            changed = True
+
+    if pad_t:
+        fmask = _pad_axis(fmask, 1, pad_t)
+        features = _pad_axis(features, features.ndim - 1, pad_t)
+        if labels_time_distributed:
+            labels = _pad_axis(labels, labels.ndim - 1, pad_t)
+            lmask = _pad_axis(lmask, 1, pad_t)
+        changed = True
+
+    if pad_b:
+        features = pad_batch_dim(features, target_b)
+        labels = pad_batch_dim(labels, target_b)
+        lmask = pad_batch_dim(lmask, target_b)
+        fmask = pad_batch_dim(fmask, target_b) if fmask is not None else None
+        _pad_rows_counter().labels("train").inc(pad_b)
+        changed = True
+
+    if not changed:
+        return ds, n
+    return DataSet(features, labels, fmask, lmask), n
+
+
+def pad_multidataset(ds, spec: BucketSpec):
+    """Batch-dim bucketing for MultiDataSet (multi-input/output graphs):
+    every features/labels array pads on its leading dim; every output gets
+    a per-example mask with zeros on the padded rows. Sequence bucketing is
+    batch-path only for now (multi-output time alignment is model-specific).
+    Returns ``(padded_mds, true_examples)``.
+
+    Signature stability: mirrors ``pad_dataset`` — a bucket-aligned batch
+    STILL materializes the all-ones labels masks padding would have
+    created, so the jit signature never flickers between maskless aligned
+    batches and masked padded tails (two executables for one workload).
+    """
+    from ..data.dataset import MultiDataSet
+
+    feats = list(ds.features)
+    n = int(feats[0].shape[0])
+    if not spec.batch:
+        return ds, n
+    target_b = spec.batch_bucket(n)
+    labels = list(ds.labels)
+    lmasks = list(ds.labels_masks) if getattr(ds, "labels_masks", None) else \
+        [None] * len(labels)
+    if target_b == n and all(m is not None for m in lmasks):
+        return ds, n
+    fmasks = (list(ds.features_masks)
+              if getattr(ds, "features_masks", None) else None)
+    out_masks = []
+    for y, m in zip(labels, lmasks):
+        if m is None:
+            m = _ones_like_mask(y, (n,))
+        out_masks.append(pad_batch_dim(m, target_b))
+    if target_b > n:
+        _pad_rows_counter().labels("train").inc(target_b - n)
+    return MultiDataSet(
+        [pad_batch_dim(f, target_b) for f in feats],
+        [pad_batch_dim(y, target_b) for y in labels],
+        features_masks=(None if fmasks is None else
+                        [pad_batch_dim(m, target_b) for m in fmasks]),
+        labels_masks=out_masks,
+    ), n

@@ -1,0 +1,181 @@
+"""Persistent compiled-executable cache (ISSUE 12 tentpole layer 2).
+
+Wires JAX's on-disk compilation cache behind one env contract:
+
+- ``TDL_COMPILE_CACHE_DIR`` — directory holding serialized XLA executables.
+  Set by :class:`~deeplearning4j_tpu.parallel.supervisor.GangSupervisor`
+  (stable ``workdir/compile_cache``, same pattern as ``TDL_FLIGHT_DIR`` /
+  ``TDL_HISTORY_DIR``) and by the serving builder
+  (``JsonModelServer.Builder.compile_cache_dir``); any process may also
+  export it directly.
+
+A respawned gang rank or a warming serving replica then *restores* its
+step/forward executables from disk instead of re-paying full XLA
+compilation: on a cache hit jax returns the deserialized executable before
+``backend_compile`` ever runs, so ``tdl_xla_compiles_total{fn}`` stays flat
+across the restart — exactly the "compiles flat after warmup, even across a
+restart" contract (pinned by tests/test_compile_cache.py).
+
+``enable()`` is idempotent and cheap to call from every entry point that is
+about to build an executable (fit loops, executors, trainers); the first
+call also installs the hit/miss metrics listener
+(``monitoring.compilecache``), so ``tdl_compile_cache_{hits,misses}_total``
+are attributed per-fn through the same ``note_signature`` thread
+announcements the recompile watchdog uses.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+ENV_DIR = "TDL_COMPILE_CACHE_DIR"
+
+_lock = threading.Lock()
+_enabled_dir: Optional[str] = None
+_env_checked = False
+
+
+def enable(directory: str) -> str:
+    """Point jax's persistent compilation cache at ``directory`` (created
+    if missing) and install the cache metrics listener. Idempotent; a
+    second call with a DIFFERENT directory re-points the cache (jax reads
+    the config per compile) and logs the switch."""
+    global _enabled_dir
+    directory = os.path.abspath(directory)
+    with _lock:
+        if _enabled_dir == directory:
+            return directory
+        os.makedirs(directory, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", directory)
+        # cache EVERY executable: the default thresholds (1s compile time,
+        # non-zero entry size) would silently skip exactly the small steady
+        # executables whose recompile-on-restart churn this kills
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # jax memoizes its is-cache-used decision on the FIRST compile of
+        # the process; enabling after any earlier compile would be a silent
+        # no-op without this reset
+        from jax.experimental.compilation_cache import compilation_cache
+
+        compilation_cache.reset_cache()
+        if _enabled_dir is not None:
+            log.info("compile cache re-pointed %s -> %s",
+                     _enabled_dir, directory)
+        _enabled_dir = directory
+    from ..monitoring import compilecache
+
+    compilecache.install(directory)
+    return directory
+
+
+def _unsafe_multiprocess_cpu() -> bool:
+    """True on a multi-process CPU (gloo) gang: deserialized XLA:CPU
+    executables carrying cross-process collectives crash on reload
+    (observed: respawned CPU gangs die SIGSEGV/SIGABRT on their first
+    restored step). The cache stays on for TPU gangs — serialized TPU
+    executables are the cache's designed-for case — and for every
+    single-process path, CPU included. Probed WITHOUT initializing the
+    backend (env/config only): this runs from constructors that may
+    execute before a worker's first computation."""
+    try:
+        import jax
+        from jax._src import distributed
+
+        if distributed.global_state.client is None:
+            return False
+        plats = (jax.config.jax_platforms
+                 or os.environ.get("JAX_PLATFORMS") or "")
+        return plats.split(",")[0].strip().lower() == "cpu"
+    except Exception:
+        return False
+
+
+def maybe_enable_from_env() -> Optional[str]:
+    """Enable the cache iff ``TDL_COMPILE_CACHE_DIR`` is set (and this
+    process can safely use it — see :func:`_unsafe_multiprocess_cpu`).
+    Called from the executable-building entry points; one env lookup when
+    unset."""
+    global _env_checked
+    directory = os.environ.get(ENV_DIR)
+    if not directory:
+        return _enabled_dir
+    if _unsafe_multiprocess_cpu():
+        # re-probed on EVERY entry point, not just the first enable: the
+        # first net/executor can be built before jax.distributed
+        # initializes (the probe still answers safe), so an early env
+        # enable must be revoked once the process turns out to be a
+        # multi-process CPU gang — respawning into reloaded XLA:CPU
+        # collective executables segfaults
+        if not _env_checked:
+            log.info("compile cache: skipping %s on a multi-process CPU "
+                     "gang (reloaded XLA:CPU collective executables are "
+                     "not crash-safe); TPU gangs and single-process runs "
+                     "use it normally", directory)
+        _env_checked = True
+        if _enabled_dir == os.path.abspath(directory):
+            disable()
+        return None
+    _env_checked = True
+    if _enabled_dir is not None:
+        # an explicit enable() (serving builder compile_cache_dir, test
+        # fixture) WINS over the env contract: re-pointing here would strand
+        # the already-persisted executables in a directory the operator
+        # never asked for — the next entry point silently moving the cache
+        # is exactly the kind of spooky action this module exists to kill
+        return _enabled_dir
+    return enable(directory)
+
+
+def disable() -> None:
+    """Stop persisting executables (tests: an enabled cache is process-wide
+    jax config — a test pointing it at tmp_path must reset it so later
+    tests don't write into a deleted directory)."""
+    global _enabled_dir
+    with _lock:
+        if _enabled_dir is None:
+            return
+        import jax
+        from jax.experimental.compilation_cache import compilation_cache
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        compilation_cache.reset_cache()
+        _enabled_dir = None
+    from ..monitoring import watchdogs
+
+    watchdogs.disable_announcements()
+
+
+def cache_dir() -> Optional[str]:
+    """The enabled cache directory, or None."""
+    return _enabled_dir
+
+
+def enabled() -> bool:
+    return _enabled_dir is not None
+
+
+def cache_size_bytes(directory: Optional[str] = None) -> int:
+    """Total bytes of serialized executables on disk (the
+    ``tdl_compile_cache_bytes`` gauge's source)."""
+    directory = directory or _enabled_dir
+    if not directory:
+        return 0
+    total = 0
+    try:
+        with os.scandir(directory) as it:
+            for entry in it:
+                try:
+                    if entry.is_file(follow_symlinks=False):
+                        total += entry.stat(follow_symlinks=False).st_size
+                except OSError:
+                    continue
+    except OSError:
+        return 0
+    return total

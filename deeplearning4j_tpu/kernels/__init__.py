@@ -19,11 +19,21 @@ from .attention import (
     ring_attention,
     ulysses_attention,
 )
+from .autotune import (
+    AutotuneTable,
+    autotune_flash_attention,
+    resolve_blocks,
+    static_flash_blocks,
+)
 
 __all__ = [
+    "AutotuneTable",
+    "autotune_flash_attention",
     "dot_product_attention",
     "flash_attention",
     "mha_reference",
+    "resolve_blocks",
     "ring_attention",
+    "static_flash_blocks",
     "ulysses_attention",
 ]

@@ -430,8 +430,10 @@ def flash_attention(q, k, v, mask=None, *, segment_ids=None, causal: bool = Fals
     to id -1. Sequence lengths need NOT be multiples of the block size — a
     pad shim rounds them up and masks the padding out (VERDICT r4 weak #2:
     no more silent fallback for masked or odd-length batches). Block sizes
-    default to 128², scaling to (512, 1024) at T ≥ 4096 (measured long-T
-    sweet spot on v5e; SURVEY §5.7 long-context mandate).
+    come from the persistent autotune table when it holds a measured entry
+    for this (shape-bucket, dtype), else from the hand-measured static
+    table (128² default, (512, 1024) at T ≥ 4096 — the measured long-T
+    sweet spot on v5e; see ``kernels.autotune``, ISSUE 12).
 
     Differentiable via custom_vjp: the forward kernel emits the per-row
     logsumexp; the backward kernels recompute each [bq,bk] prob block in VMEM
@@ -449,13 +451,16 @@ def flash_attention(q, k, v, mask=None, *, segment_ids=None, causal: bool = Fals
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None or block_k is None:
-        # long sequences want coarse tiles: the grid runs sequentially per
-        # core, and at T=8k (512, 1024) blocks measured 3.6x faster than
-        # the 128-block default fwd+bwd on v5e (r5, BASELINE.md) — also
-        # beating the dense path, which OOMs by T=16k anyway
-        long_t = min(Tq, Tk) >= 4096
-        block_q = block_q or (512 if long_t else 128)
-        block_k = block_k or (1024 if long_t else 128)
+        # ISSUE 12: a measured per-(op, shape-bucket, dtype) winner from the
+        # persistent autotune table wins; the hand-measured static table
+        # (128² default, coarse (512, 1024) tiles at long T — the grid runs
+        # sequentially per core) answers when nothing was measured yet
+        from .autotune import resolve_blocks
+
+        abq, abk = resolve_blocks("flash_attention", B=B, H=H, Tq=Tq, Tk=Tk,
+                                  D=D, dtype=jnp.dtype(q.dtype).name)
+        block_q = block_q or abq
+        block_k = block_k or abk
 
     qseg = kseg = None
     if segment_ids is not None:

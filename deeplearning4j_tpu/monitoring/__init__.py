@@ -25,7 +25,10 @@
   bucket-interpolated quantiles) every windowed consumer uses (ISSUE 11);
 - :mod:`.slo` — declarative SLO objectives compiled against the history
   ring: attainment, error-budget remaining and burn rate exported as
-  ``tdl_slo_*`` gauges and served at ``UIServer /slo``.
+  ``tdl_slo_*`` gauges and served at ``UIServer /slo``;
+- :mod:`.compilecache` — persistent-compile-cache hit/miss counters,
+  attributed per fn through the watchdogs' thread announcements (ISSUE 12;
+  installed by ``common.compile_cache.enable``).
 """
 
 from .aggregate import MetricsSpooler, maybe_spool, merged_prometheus

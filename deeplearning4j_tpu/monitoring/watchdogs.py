@@ -190,6 +190,98 @@ _HOOK_LOCK = threading.Lock()
 _HOOK_INSTALLED = False
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
+# -- shared thread announcements (ISSUE 12) ----------------------------------
+# The compile-cache monitor (monitoring.compilecache) attributes cache
+# hits/misses per fn through the SAME note_signature announcements the
+# watchdogs use, but it must work with no RecompileWatchdog installed (a
+# production serving replica wants cache counters without churn tracking).
+# One module-level store, thread-keyed like the per-watchdog tables.
+#
+# Event ordering with the persistent cache ON (measured against jax 0.4.37,
+# pinned by tests/test_compile_cache.py): the backend_compile duration event
+# wraps jax's WHOLE compile_or_get_cached — it fires on cache HITS too (a
+# few ms of deserialization), and the cache hit/miss events fire INSIDE the
+# timed block, i.e. BEFORE the duration event. So:
+#   - cache_misses → peek the pending announcement (the duration event that
+#     follows will claim it for the compile counters);
+#   - cache_hits → consume the pending announcement (nothing compiled) and
+#     mark the thread, so the duration event that follows is recognized as
+#     a RESTORE and skipped — an executable loaded from disk must not count
+#     in tdl_xla_compiles_total, or "compiles flat across a restart" would
+#     be unmeasurable.
+_CC_LOCK = threading.Lock()
+_CC_PENDING: Dict[int, Tuple[str, float]] = {}
+_CC_HIT_MARK: Dict[int, float] = {}
+_ANNOUNCE_EXTRA = False
+
+
+def enable_announcements() -> None:
+    """Make ``note_signature`` record thread announcements (and install the
+    compile hook) even with no RecompileWatchdog — the compile-cache
+    monitor's attribution path."""
+    global _ANNOUNCE_EXTRA
+    _ANNOUNCE_EXTRA = True
+    _install_hook()
+
+
+def disable_announcements() -> None:
+    """Stop cache-monitor announcements (``common.compile_cache.disable``):
+    with no active watchdog either, instrumented call sites go back to
+    paying nothing per step."""
+    global _ANNOUNCE_EXTRA
+    _ANNOUNCE_EXTRA = False
+
+
+def _cc_note(fn_name: str, signature) -> None:
+    # EVERY announcement overwrites (no per-signature memory): a dispatch
+    # that hits jax's in-memory jit cache produces no event and the stale
+    # announcement is simply replaced by the next one — while a dispatch
+    # whose executable cache was dropped (fresh process restoring from
+    # disk) is correctly pending when its cache-hit event fires
+    with _CC_LOCK:
+        _CC_PENDING[threading.get_ident()] = (fn_name, time.monotonic())
+
+
+def peek_pending_fn() -> Optional[str]:
+    """This thread's fresh pending announcement WITHOUT consuming it
+    (cache-MISS attribution: the miss event fires before the duration event
+    that will claim the announcement for the compile counters)."""
+    now = time.monotonic()
+    with _CC_LOCK:
+        pending = _CC_PENDING.get(threading.get_ident())
+    if pending is not None and now - pending[1] <= ATTRIBUTION_WINDOW_S:
+        return pending[0]
+    return None
+
+
+def take_pending_fn() -> Optional[str]:
+    """Consume this thread's pending announcement (cache-HIT attribution:
+    the announced dispatch was satisfied from disk; no compile should claim
+    it later). None when nothing fresh is pending."""
+    now = time.monotonic()
+    with _CC_LOCK:
+        pending = _CC_PENDING.pop(threading.get_ident(), None)
+    if pending is not None and now - pending[1] <= ATTRIBUTION_WINDOW_S:
+        return pending[0]
+    return None
+
+
+def note_cache_hit() -> None:
+    """Mark this thread as having just restored an executable from the
+    persistent cache: the backend_compile duration event that follows wraps
+    the retrieval, not a compile, and will be skipped."""
+    with _CC_LOCK:
+        _CC_HIT_MARK[threading.get_ident()] = time.monotonic()
+
+
+def _was_cache_restore(duration: float) -> bool:
+    now = time.monotonic()
+    with _CC_LOCK:
+        mark = _CC_HIT_MARK.pop(threading.get_ident(), None)
+    # the hit event fired INSIDE the timed block — it can't be older than
+    # the block itself (small slack for listener scheduling)
+    return mark is not None and now - mark <= duration + 5.0
+
 
 def _install_hook() -> None:
     global _HOOK_INSTALLED
@@ -200,6 +292,22 @@ def _install_hook() -> None:
 
         def on_duration(event: str, duration: float, **kw) -> None:
             if event == _COMPILE_EVENT:
+                tid = threading.get_ident()
+                if _was_cache_restore(duration):
+                    # deserialized from disk: not a compile — but the
+                    # announcement is SPENT, incl. each watchdog's copy, or
+                    # the thread's next unannounced compile (within the
+                    # 120s window) would inherit the restored fn's label
+                    # and mint a phantom per-fn recompile
+                    for wd in list(_ACTIVE):
+                        with wd._lock:
+                            wd._pending.pop(tid, None)
+                    return
+                # a real compile consumes this thread's announcement (the
+                # miss event already peeked it) so a later unannounced
+                # compile can't inherit the label
+                with _CC_LOCK:
+                    _CC_PENDING.pop(tid, None)
                 for wd in list(_ACTIVE):
                     wd._on_compile(duration)
 
@@ -208,9 +316,10 @@ def _install_hook() -> None:
 
 
 def active() -> bool:
-    """True when at least one RecompileWatchdog is installed — instrumented
-    call sites guard signature computation behind this (zero-cost when off)."""
-    return bool(_ACTIVE)
+    """True when an instrumented call site should compute signatures: a
+    RecompileWatchdog is installed, or the compile-cache monitor asked for
+    announcements (zero-cost when both are off)."""
+    return bool(_ACTIVE) or _ANNOUNCE_EXTRA
 
 
 def note_step() -> None:
@@ -223,9 +332,10 @@ def note_step() -> None:
 def note_signature(fn_name: str, signature) -> None:
     """Record a call signature for ``fn_name`` (called by the fit loops
     with the minibatch shape/dtype signature). No-op with no active
-    watchdog."""
-    if not _ACTIVE:
+    watchdog or cache monitor."""
+    if not _ACTIVE and not _ANNOUNCE_EXTRA:
         return
+    _cc_note(fn_name, signature)
     for wd in list(_ACTIVE):
         wd.note_signature(fn_name, signature)
 

@@ -29,6 +29,10 @@ from .multilayer import _grad_normalize, _mask_frozen, _LazyScoreMixin
 
 class ComputationGraph(_LazyScoreMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
+        # ISSUE 12: honor TDL_COMPILE_CACHE_DIR before the first jit builds
+        from ..common import compile_cache
+
+        compile_cache.maybe_enable_from_env()
         self.conf = conf
         self.params_: Dict[str, Any] = {}
         self.bn_state: Dict[str, Any] = {}
@@ -318,6 +322,18 @@ class ComputationGraph(_LazyScoreMixin):
         return self
 
     def _fit_one(self, ds):
+        true_n = None
+        if self._bucketing is not None:
+            # ISSUE 12: pad to the shared bucket policy BEFORE coercion so a
+            # ragged final batch reuses the bucket's executable; the padded
+            # rows carry a zero labels-mask (loss parity — common.bucketing)
+            from ..common import bucketing as _bucketing_mod
+
+            if isinstance(ds, DataSet):
+                ds, true_n = _bucketing_mod.pad_dataset(ds, self._bucketing)
+            else:
+                ds, true_n = _bucketing_mod.pad_multidataset(
+                    ds, self._bucketing)
         if isinstance(ds, DataSet):
             inputs = self._coerce_inputs([ds.features])
             labels = self._coerce_labels([ds.labels])
@@ -330,13 +346,15 @@ class ComputationGraph(_LazyScoreMixin):
                 if ds.labels_masks
                 else None
             )
-        self._fit_batch(inputs, labels, lmasks)
+        self._fit_batch(inputs, labels, lmasks, true_examples=true_n)
 
-    def _fit_batch(self, inputs, labels, lmasks):
+    def _fit_batch(self, inputs, labels, lmasks, true_examples=None):
         step = self._train_step_fn()
         rng = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self.iteration)
         first = next(iter(inputs.values()))
-        self.last_batch_size = int(first.shape[0])
+        # TRUE count when bucketing padded this batch (ISSUE 12 satellite)
+        self.last_batch_size = (true_examples if true_examples is not None
+                                else int(first.shape[0]))
         if _watchdogs.active():  # recompile watchdog: shape-churn detection
             _watchdogs.note_step()
             _watchdogs.note_signature(

@@ -142,9 +142,77 @@ class _LazyScoreMixin:
     def _features_dtype(self):
         return self._wire_dtype()
 
+    # -- shape bucketing (ISSUE 12) -----------------------------------------
+    # shared by MultiLayerNetwork and ComputationGraph: ragged final batches
+    # (and, opted in, variable sequence lengths) pad to the serving bucket
+    # policy so they stop minting fresh XLA signatures; padding rows carry a
+    # zero labels-mask, so loss/grads match the unpadded batch exactly (the
+    # masked mean divides by the true count — common.bucketing docstring)
+
+    _bucketing = None
+
+    def set_bucketing(self, spec):
+        """Install a :class:`~deeplearning4j_tpu.common.bucketing.BucketSpec`
+        (or ``True`` for the defaults, ``None`` to disable) on the fit
+        paths. ``last_batch_size`` keeps reporting the TRUE example count,
+        never the padded one.
+
+        Refuses nets with BatchNormalization: the labels mask keeps padded
+        rows out of the LOSS, but BN's batch mean/variance are computed over
+        every row of the padded batch — phantom zero rows would silently
+        change the training dynamics vs unbucketed (no parity), so this
+        raises instead."""
+        from ..common.bucketing import BucketSpec
+
+        if spec is True:
+            spec = BucketSpec()
+        if spec is not None and spec.batch:
+            from .conf import BatchNormalization
+
+            for name, layer in self._iter_layer_confs():
+                if isinstance(layer, BatchNormalization):
+                    raise ValueError(
+                        "shape bucketing is unsupported with "
+                        f"BatchNormalization (layer {name}): padded zero "
+                        "rows would enter the batch mean/variance, silently "
+                        "breaking parity with unbucketed training; train "
+                        "without bucketing (ragged tails fall back to one "
+                        "executable per distinct shape)")
+        self._bucketing = spec
+        return self
+
+    def _iter_layer_confs(self):
+        """(name, layer-conf) pairs — MultiLayerNetwork stores a layer list,
+        ComputationGraph a node dict; bucketing guards need to scan both."""
+        conf = getattr(self, "conf", None)
+        layers = getattr(conf, "layers", None)
+        if layers is not None:
+            for i, layer in enumerate(layers):
+                yield str(i), layer
+            return
+        nodes = getattr(conf, "nodes", None) or {}
+        for name, node in nodes.items():
+            layer = getattr(node, "layer", None)
+            if layer is not None:
+                yield name, layer
+
+    def _bucket_dataset(self, ds):
+        """(possibly padded ds, true example count or None when disabled)."""
+        if self._bucketing is None:
+            return ds, None
+        from ..common.bucketing import pad_dataset
+
+        return pad_dataset(ds, self._bucketing)
+
 
 class MultiLayerNetwork(_LazyScoreMixin):
     def __init__(self, conf: MultiLayerConfiguration):
+        # persistent executable cache (ISSUE 12): honor the supervisor's /
+        # operator's TDL_COMPILE_CACHE_DIR before the first jit builds, so a
+        # respawned gang restores its step executables from disk
+        from ..common import compile_cache
+
+        compile_cache.maybe_enable_from_env()
         self.conf = conf
         self.params_: Dict[str, Any] = {}
         self.bn_state: Dict[str, Any] = {}
@@ -488,9 +556,11 @@ class MultiLayerNetwork(_LazyScoreMixin):
                 lst.iteration_done(self, self.iteration, self.epoch)
         return losses
 
-    def _fit_batch(self, ds: DataSet):
+    def _fit_batch(self, ds: DataSet, true_examples: Optional[int] = None):
+        if true_examples is None:
+            ds, true_examples = self._bucket_dataset(ds)
         if self.conf.backprop_type == "TruncatedBPTT" and self.conf.tbptt_fwd_length > 0:
-            self._fit_tbptt(ds)
+            self._fit_tbptt(ds, true_examples)
             return
         step = self._train_step_fn()
         rng = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self.iteration)
@@ -498,7 +568,10 @@ class MultiLayerNetwork(_LazyScoreMixin):
         y = self._put(ds.labels)
         fmask = self._put(ds.features_mask)
         lmask = self._put(ds.labels_mask)
-        self.last_batch_size = int(x.shape[0])
+        # the TRUE count when bucketing padded this batch — samples/sec
+        # listeners must never count phantom rows (ISSUE 12 satellite)
+        self.last_batch_size = (true_examples if true_examples is not None
+                                else int(x.shape[0]))
         if _watchdogs.active():  # recompile watchdog: shape-churn detection
             _watchdogs.note_step()
             _watchdogs.note_signature(
@@ -520,7 +593,7 @@ class MultiLayerNetwork(_LazyScoreMixin):
             if hasattr(lst, "iteration_done"):
                 lst.iteration_done(self, self.iteration, self.epoch)
 
-    def _fit_tbptt(self, ds: DataSet):
+    def _fit_tbptt(self, ds: DataSet, true_examples: Optional[int] = None):
         """Truncated BPTT (MultiLayerNetwork fitHelper tbptt path): split the
         time axis into fwdLen segments; carry LSTM state across segments with
         stop-gradient between them.
@@ -552,6 +625,11 @@ class MultiLayerNetwork(_LazyScoreMixin):
         rnn_states = self._zero_rnn_states(B)
         lm_all = (stage(ds.labels_mask, np.float32) if ds.labels_mask is not None
                   else np.ones((B, T), np.float32))
+        if lm_all.ndim == 1:
+            # a per-example [B] mask (batch bucketing pads rows with mask 0):
+            # broadcast to the [B, T] per-timestep form this path segments —
+            # padded rows mask out every timestep, real rows keep all of them
+            lm_all = (lm_all[:, None] * xp(lm_all).ones((1, T), np.float32))
         fm_all = None if ds.features_mask is None else stage(ds.features_mask, np.float32)
         pad = (-T) % fwd
         if pad:
@@ -580,7 +658,7 @@ class MultiLayerNetwork(_LazyScoreMixin):
         lmj = to_segs(self._put(lm_all))
         fmj = None if fm_all is None else to_segs(self._put(fm_all))
         rng = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self.iteration)
-        self.last_batch_size = B
+        self.last_batch_size = true_examples if true_examples is not None else B
         if _watchdogs.active():
             _watchdogs.note_step()
             _watchdogs.note_signature(

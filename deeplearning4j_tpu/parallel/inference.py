@@ -34,13 +34,22 @@ class ParallelInference:
     def _bucket(self, n: int) -> int:
         """Smallest power-of-2 multiple of the data-axis size that fits n —
         always divisible by the mesh, always >= n; batch_limit only seeds the
-        smallest bucket so tiny requests share one executable."""
-        b = self._ndata
-        while b < self.batch_limit:
-            b *= 2
-        while b < n:
-            b *= 2
-        return b
+        smallest bucket so tiny requests share one executable. The policy
+        itself lives in ``common.bucketing`` (ISSUE 12: the training/eval
+        fit paths bucket with the same rule)."""
+        from ..common.bucketing import bucket_size
+
+        return bucket_size(n, min_bucket=self.batch_limit,
+                           multiple=self._ndata)
+
+    def bucket_sizes(self, max_rows: int):
+        """Every bucket this instance can produce up to ``_bucket(max_rows)``,
+        smallest first — the serving executor pre-warms this ladder so the
+        first large-batch request never pays a compile (ISSUE 12 satellite)."""
+        from ..common.bucketing import bucket_ladder
+
+        return bucket_ladder(max_rows, min_bucket=self.batch_limit,
+                             multiple=self._ndata)
 
     def output(self, x) -> np.ndarray:
         """Pad to a bucketed batch size, run the sharded forward, trim."""

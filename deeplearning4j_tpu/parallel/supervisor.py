@@ -44,6 +44,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..common import compile_cache
 from ..monitoring import aggregate, flight, history
 from ..monitoring.flight import FlightRecorder
 from ..monitoring.heartbeat import ENV_DIR, ENV_INTERVAL, read_heartbeat
@@ -240,6 +241,10 @@ class GangSupervisor:
         self.spool_dir = os.path.join(self.workdir, "spool")
         #: stable per-proc history-ring dir (ISSUE 11): windowed /history
         self.history_dir = os.path.join(self.workdir, "history")
+        #: stable persistent-executable-cache dir (ISSUE 12): a respawned
+        #: incarnation restores its XLA executables from here instead of
+        #: recompiling — compiles stay flat across the restart
+        self.compile_cache_dir = os.path.join(self.workdir, "compile_cache")
 
         self.events: List[GangEvent] = []
         self.restarts = 0           # budgeted restarts performed
@@ -317,14 +322,9 @@ class GangSupervisor:
 
     # ------------------------------------------------------------ lifecycle
 
-    def _spawn(self, attempt: int):
-        # per-ATTEMPT dirs keep heartbeats/logs of a bind-race respawn from
-        # colliding, but the worker-visible restart count is only the
-        # BUDGETED restarts: a bind respawn never recovered from a failure,
-        # so workers (and incarnation-gated fault clauses) must not see it
-        hb_dir = os.path.join(self.workdir, f"hb_{attempt}")
-        log_dir = os.path.join(self.workdir, f"logs_{attempt}")
-        os.makedirs(hb_dir, exist_ok=True)
+    def _child_env(self, attempt: int, hb_dir: str) -> Dict[str, str]:
+        """The env contract one gang incarnation runs under (factored out of
+        ``_spawn`` so tests can pin it without spawning processes)."""
         env = dict(self.extra_env)
         env[ENV_INCARNATION] = str(self.restarts)
         env[ENV_DIR] = hb_dir
@@ -347,9 +347,27 @@ class GangSupervisor:
         # metrics spool: windowed alert/SLO views spanning a restart are the
         # point — read_rings dedupes incarnations by newest ring per proc
         env.setdefault(history.ENV_DIR, os.path.join(self.workdir, "history"))
+        # persistent executable cache (ISSUE 12): STABLE across attempts by
+        # construction — the whole point is that incarnation N+1 restores
+        # the executables incarnation N compiled, so a respawn-from-
+        # checkpoint pays deserialization, not XLA compilation
+        env.setdefault(compile_cache.ENV_DIR,
+                       os.path.join(self.workdir, "compile_cache"))
         self.flight_dir = env[flight.ENV_DIR]
         self.spool_dir = env[aggregate.ENV_DIR]
         self.history_dir = env[history.ENV_DIR]
+        self.compile_cache_dir = env[compile_cache.ENV_DIR]
+        return env
+
+    def _spawn(self, attempt: int):
+        # per-ATTEMPT dirs keep heartbeats/logs of a bind-race respawn from
+        # colliding, but the worker-visible restart count is only the
+        # BUDGETED restarts: a bind respawn never recovered from a failure,
+        # so workers (and incarnation-gated fault clauses) must not see it
+        hb_dir = os.path.join(self.workdir, f"hb_{attempt}")
+        log_dir = os.path.join(self.workdir, f"logs_{attempt}")
+        os.makedirs(hb_dir, exist_ok=True)
+        env = self._child_env(attempt, hb_dir)
         procs = launcher.spawn(
             self.target, self.n_processes, self.n_local_devices,
             self.platform, extra_env=env, args=self.args, cwd=self.cwd,

@@ -67,8 +67,19 @@ class ParallelTrainer:
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, data_axis: str = AXIS_DATA,
-                 sharding_rules=None, mesh_layout=None):
+                 sharding_rules=None, mesh_layout=None, bucketing=None):
+        # persistent executable cache (ISSUE 12): a respawned gang rank
+        # constructs its trainer before its first compile — honoring the
+        # supervisor's TDL_COMPILE_CACHE_DIR here restores executables from
+        # the stable workdir/compile_cache instead of recompiling
+        from ..common import compile_cache
+
+        compile_cache.maybe_enable_from_env()
         self.net = net
+        if bucketing is not None:
+            # ISSUE 12: pad-to-bucket on the fit paths; the mesh-divisibility
+            # constraint is folded in below once _ndata is known
+            net.set_bucketing(bucketing)
         # ISSUE 9: mesh_layout=SpecLayout(data=D, fsdp=F, tp=T) turns the
         # replicated gang into sharded-parameter training — params AND
         # optimizer state placed per layer role over the fsdp/tp axes, batch
@@ -269,8 +280,37 @@ class ParallelTrainer:
             flight.flush()
         return self.net
 
+    def _bucket_multiple(self) -> int:
+        """Divisibility the bucket must satisfy: the whole data-axis size
+        here (single process feeds the whole global batch); the PER-PROCESS
+        share on MultiProcessTrainer (each rank feeds only its local shard —
+        folding the global size there would over-pad every ragged tail by
+        up to process_count x)."""
+        return self._ndata
+
+    def _bucket_for_mesh(self, ds):
+        """Pad ``ds`` to the net's bucket spec with the mesh divisibility
+        requirement folded into the bucket multiple, so a bucketed batch is
+        always device-divisible and the remainder fallback stays dead.
+        Returns ``(ds, true_examples_or_None)``."""
+        spec = getattr(self.net, "_bucketing", None)
+        if spec is None:
+            return ds, None
+        import math
+        from dataclasses import replace
+
+        from ..common.bucketing import pad_dataset
+
+        multiple = self._bucket_multiple()
+        if spec.batch_multiple % multiple:
+            spec = replace(spec, batch_multiple=math.lcm(
+                spec.batch_multiple, multiple))
+        return pad_dataset(ds, spec)
+
     def _fit_batch(self, ds: DataSet):
         self._place_net()  # idempotent: direct _fit_batch callers skip fit()
+        ds, true_n = self._bucket_for_mesh(ds)
+        self._bucketed_true_examples = true_n
         b = ds.num_examples()  # shape read only: never syncs a device batch
         rem = b % self._ndata
         if rem:
@@ -330,12 +370,16 @@ class ParallelTrainer:
         n = self.net
         from ..nn.multilayer import MultiLayerNetwork
 
+        # already padded by _bucket_for_mesh (mesh-divisible bucket): hand
+        # the TRUE example count down so last_batch_size stays honest and
+        # the net doesn't re-pad
+        true_n = getattr(self, "_bucketed_true_examples", None)
         if isinstance(n, MultiLayerNetwork):
             # route through the net's OWN fit paths (incl. tbptt) with the
             # placement hook sharding every minibatch array over the mesh
             n._input_put = self._shard_placed
             try:
-                n._fit_batch(ds)
+                n._fit_batch(ds, true_examples=true_n)
             finally:
                 n._input_put = None
         else:  # ComputationGraph
@@ -354,6 +398,8 @@ class ParallelTrainer:
                 jnp.asarray(n.iteration, jnp.int32), jnp.asarray(n.epoch, jnp.int32),
                 inputs, labels, lmasks, rng)
             n.score_ = loss  # lazy: syncs only when read
+            n.last_batch_size = (true_n if true_n is not None
+                                 else ds.num_examples())
             n.iteration += 1
             for lst in n.listeners:
                 if hasattr(lst, "iteration_done"):
@@ -411,15 +457,25 @@ class MultiProcessTrainer(ParallelTrainer):
 
         return jax.process_index(), jax.process_count()
 
+    def _bucket_multiple(self) -> int:
+        # each rank buckets its LOCAL shard: divisibility only needs the
+        # process-local device count (same invariant _fit_batch checks) —
+        # lockstep feeds then land on the same bucket on every rank
+        import jax
+
+        return max(1, len(self.mesh.devices.flat) // jax.process_count())
+
     def _fit_batch(self, ds: DataSet):
         # the single-process remainder fallback cannot cross process
         # boundaries (it would mix global params with per-process inputs), so
         # multiprocess input pipelines must feed divisible LOCAL batches
-        import jax
-
         self._place_net()  # idempotent: direct _fit_batch callers skip fit()
+        ds, true_n = self._bucket_for_mesh(ds)
+        self._bucketed_true_examples = true_n
         b = ds.num_examples()
-        local = max(1, len(self.mesh.devices.flat) // jax.process_count())
+        if getattr(self.net, "_bucketing", None) is not None:
+            _check_lockstep_buckets(b)
+        local = self._bucket_multiple()
         if b % local:
             raise ValueError(
                 f"multi-process local batch {b} must be divisible by the "
@@ -448,6 +504,29 @@ class MultiProcessTrainer(ParallelTrainer):
 
     def _shard_placed(self, x):
         return self._shard(x)
+
+
+def _check_lockstep_buckets(b: int) -> None:
+    """Every process must pad to the SAME bucket: per-rank ragged tails that
+    straddle a power-of-2 boundary (17 vs 16 rows) would otherwise hand
+    ``make_array_from_process_local_data`` mismatched local shapes — a hang
+    in the first collective instead of an error. One tiny allgather per
+    batch (only when bucketing is enabled, so every rank participates)
+    turns that into a deterministic ValueError."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    sizes = np.asarray(multihost_utils.process_allgather(  # host-ok: tiny fully-replicated int vector, host read is the point
+        np.int32(b))).ravel()
+    if not (sizes == sizes[0]).all():
+        raise ValueError(
+            "bucketed local batch sizes diverged across processes: "
+            f"{sizes.tolist()} — multi-process bucketing requires lockstep "
+            "feeds (the same local batch size on every rank each step); "
+            "shard with shard_batches/sharded_etl or equalize the iterator")
 
 
 def _slice_ds(ds: DataSet, a: int, b: int) -> DataSet:

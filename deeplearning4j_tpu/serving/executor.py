@@ -162,7 +162,8 @@ class BatchingInferenceExecutor:
     def __init__(self, model=None, parallel_inference=None, *,
                  max_queue: int = 64, max_batch_rows: int = 128,
                  default_deadline_ms: Optional[float] = None,
-                 warmup_input=None, registry=None, span_sample_n: int = 1):
+                 warmup_input=None, registry=None, span_sample_n: int = 1,
+                 warmup_all_buckets: Optional[bool] = None):
         if model is None and parallel_inference is None:
             raise ValueError("need a model or a ParallelInference")
         self.model = model
@@ -175,6 +176,13 @@ class BatchingInferenceExecutor:
         self.max_batch_rows = max_batch_rows
         self.default_deadline_ms = default_deadline_ms
         self.span_sample_n = span_sample_n
+        #: ISSUE 12 satellite: warm EVERY ParallelInference bucket up to
+        #: max_batch_rows, not just the smallest, so the first large-batch
+        #: request never eats a compile. None = auto: only when the
+        #: persistent compile cache is enabled (warming the ladder is then
+        #: cheap — each bucket restores from disk after the first-ever run);
+        #: True forces it regardless.
+        self.warmup_all_buckets = warmup_all_buckets
         self._warmup_input = warmup_input
         self._m = serving_metrics(registry)
         self._q: deque = deque()
@@ -188,6 +196,11 @@ class BatchingInferenceExecutor:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "BatchingInferenceExecutor":
+        # ISSUE 12: honor TDL_COMPILE_CACHE_DIR before the warmup compiles —
+        # a warming replica then restores its bucket executables from disk
+        from ..common import compile_cache
+
+        compile_cache.maybe_enable_from_env()
         with self._cv:
             if self._thread is not None:
                 return self
@@ -295,8 +308,8 @@ class BatchingInferenceExecutor:
 
     def _loop(self) -> None:
         if self._warmup_input is not None:
-            try:  # compile the smallest bucket before the first real request
-                self._run([np.asarray(self._warmup_input)])
+            try:
+                self._warmup()
             except Exception:
                 log.exception("serving warmup failed — the first request "
                               "will pay the XLA compile instead")
@@ -316,6 +329,29 @@ class BatchingInferenceExecutor:
                 self._m.queue_depth.set(len(self._q))
             self._serve_batch(batch)
             aggregate.maybe_spool()  # serving replica's aggregated-/metrics spool
+
+    def _warmup(self) -> None:
+        """Compile (or cache-restore) the serving executables before the
+        first real request. With a ParallelInference and bucket warmup on
+        (explicitly, or auto when the persistent compile cache is enabled),
+        EVERY bucket of the padding ladder up to ``max_batch_rows`` is
+        warmed — pre-ISSUE-12 only the smallest bucket was, so the first
+        large coalesced batch ate a full XLA compile mid-traffic."""
+        from ..common import compile_cache
+
+        x = np.asarray(self._warmup_input)
+        pi = self.parallel_inference
+        warm_ladder = (self.warmup_all_buckets
+                       if self.warmup_all_buckets is not None
+                       else compile_cache.enabled())
+        if pi is None or not warm_ladder:
+            self._run([x])  # the smallest bucket (historical behavior)
+            return
+        row = x[:1] if x.ndim and x.shape[0] else x[None]
+        for b in pi.bucket_sizes(self.max_batch_rows):
+            # exactly b rows => ParallelInference pads to bucket b itself
+            self._run([np.broadcast_to(row, (b,) + row.shape[1:]).copy()])
+            log.debug("serving warmup: bucket %d ready", b)
 
     def _serve_batch(self, batch: List[InferenceFuture]) -> None:
         now = time.monotonic()

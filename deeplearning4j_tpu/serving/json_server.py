@@ -81,7 +81,19 @@ class JsonModelServer:
                  max_queue: int = 64, max_batch_rows: int = 128,
                  default_deadline_ms: float = DEFAULT_DEADLINE_MS,
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
-                 warmup_input=None, registry=None, span_sample_n: int = 1):
+                 warmup_input=None, registry=None, span_sample_n: int = 1,
+                 compile_cache_dir: Optional[str] = None,
+                 warmup_all_buckets: Optional[bool] = None):
+        # ISSUE 12: an explicit cache dir wins; else the TDL_COMPILE_CACHE_DIR
+        # env contract — enabled before any warmup compile so a warming
+        # replica restores executables from disk
+        from ..common import compile_cache
+
+        if compile_cache_dir:
+            compile_cache.enable(compile_cache_dir)
+        else:
+            compile_cache.maybe_enable_from_env()
+        self.warmup_all_buckets = warmup_all_buckets
         self.model = model
         self.deserializer = deserializer or (lambda d: np.asarray(d, np.float32))
         self.serializer = serializer or (lambda a: np.asarray(a).tolist())
@@ -149,6 +161,21 @@ class JsonModelServer:
 
         def warmup_input(self, x):
             self._kw["warmup_input"] = x
+            return self
+
+        def compile_cache_dir(self, path: str):
+            """Persist compiled executables under ``path`` (ISSUE 12): a
+            restarted replica restores them from disk instead of re-paying
+            XLA compilation at warmup. Same contract as exporting
+            ``TDL_COMPILE_CACHE_DIR``."""
+            self._kw["compile_cache_dir"] = path
+            return self
+
+        def warmup_all_buckets(self, flag: bool = True):
+            """Warm EVERY ParallelInference bucket up to max_batch_rows at
+            startup (default: auto — on iff the compile cache is enabled),
+            so the first large coalesced batch never eats a compile."""
+            self._kw["warmup_all_buckets"] = flag
             return self
 
         def span_sample(self, n: int):
@@ -331,7 +358,8 @@ class JsonModelServer:
             max_queue=self.max_queue, max_batch_rows=self.max_batch_rows,
             default_deadline_ms=self.default_deadline_ms,
             warmup_input=self.warmup_input, registry=self.registry,
-            span_sample_n=self.span_sample_n).start()
+            span_sample_n=self.span_sample_n,
+            warmup_all_buckets=self.warmup_all_buckets).start()
         server = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -1,0 +1,414 @@
+"""Framework-wide shape bucketing (ISSUE 12 tentpole layer 1).
+
+Acceptance pins:
+- the bucket policy is THE serving policy (extracted from
+  ``ParallelInference._bucket``), byte-identical over its whole domain;
+- pad-to-bucket training yields loss (and param) parity with unbucketed
+  training to 1e-6 on LeNet — padded rows are invisible to loss/grads;
+- a shape-churning workload (varying batch tail) shows compiles flat after
+  warmup: every ragged tail lands in one bucket, one signature, one
+  executable;
+- fit loops that pad report the TRUE example count as ``last_batch_size``
+  (satellite: samples/sec listeners must not count phantom rows).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.bucketing import (BucketSpec, bucket_ladder,
+                                                 bucket_size, pad_dataset,
+                                                 pad_multidataset)
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn import (ComputationGraph, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import (ConvolutionLayer, DenseLayer,
+                                        InputType, LSTM, OutputLayer,
+                                        RnnOutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def _dense_net(seed=0, n_in=8, n_out=4):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _lenet(seed=0):
+    """LeNet shape (conv-pool-conv-pool-dense) on 12x12 inputs — the
+    acceptance model, scaled so CPU tier-1 stays fast."""
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(12, 12, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ------------------------------------------------------------------ policy
+
+
+def test_bucket_size_matches_serving_policy():
+    """The extracted policy must be byte-identical to the historical
+    ParallelInference._bucket over its whole domain."""
+    def reference(n, batch_limit, ndata):
+        b = ndata
+        while b < batch_limit:
+            b *= 2
+        while b < n:
+            b *= 2
+        return b
+
+    for n in list(range(0, 600, 7)) + [1, 2, 1023, 1024, 1025]:
+        for bl in (1, 2, 8, 16, 32):
+            for nd in (1, 2, 4, 8):
+                assert bucket_size(n, min_bucket=bl, multiple=nd) == \
+                    reference(n, bl, nd), (n, bl, nd)
+
+
+def test_parallel_inference_bucket_delegates_to_common_policy():
+    net = _dense_net()
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    pi = ParallelInference(net, batch_limit=16)
+    for n in (1, 5, 16, 17, 100):
+        assert pi._bucket(n) == bucket_size(n, min_bucket=16,
+                                            multiple=pi._ndata)
+    ladder = pi.bucket_sizes(100)
+    assert ladder[-1] == pi._bucket(100)
+    assert all(b == 2 * a for a, b in zip(ladder, ladder[1:]))
+
+
+def test_bucket_ladder_covers_every_bucket():
+    assert bucket_ladder(128, min_bucket=16) == [16, 32, 64, 128]
+    assert bucket_ladder(17, min_bucket=4, multiple=2) == [4, 8, 16, 32]
+    assert bucket_ladder(1, min_bucket=8) == [8]
+
+
+# ------------------------------------------------------------- pad_dataset
+
+
+def test_pad_dataset_pads_rows_with_zero_mask():
+    ds = DataSet(np.ones((17, 3), np.float32), np.ones((17, 5), np.float32))
+    padded, n = pad_dataset(ds, BucketSpec(min_batch=32))
+    assert n == 17
+    assert padded.features.shape == (32, 3)
+    assert padded.labels.shape == (32, 5)
+    assert padded.labels_mask.shape == (32,)
+    assert padded.labels_mask[:17].all() and not padded.labels_mask[17:].any()
+    # padded feature rows are zeros, real rows untouched
+    assert (padded.features[17:] == 0).all()
+    assert (padded.features[:17] == 1).all()
+
+
+def test_pad_dataset_aligned_batch_still_carries_the_mask():
+    """An aligned batch gets the all-ones mask padding would have created:
+    the jit signature must not flicker between aligned (maskless) and
+    padded (masked) batches — that flicker IS a second executable."""
+    ds = DataSet(np.ones((32, 3), np.float32), np.ones((32, 5), np.float32))
+    padded, n = pad_dataset(ds, BucketSpec(min_batch=32))
+    assert n == 32
+    assert padded.features.shape == (32, 3)
+    assert padded.labels_mask.shape == (32,) and padded.labels_mask.all()
+
+
+def test_pad_dataset_aligned_batch_with_mask_is_identity():
+    ds = DataSet(np.ones((32, 3), np.float32), np.ones((32, 5), np.float32),
+                 None, np.ones((32,), np.float32))
+    padded, n = pad_dataset(ds, BucketSpec(min_batch=32))
+    assert padded is ds and n == 32
+
+
+def test_pad_dataset_sequence_bucketing_extends_masks():
+    B, C, T = 4, 2, 37
+    ds = DataSet(np.ones((B, C, T), np.float32),
+                 np.ones((B, C, T), np.float32),
+                 None, np.ones((B, T), np.float32))
+    padded, n = pad_dataset(ds, BucketSpec(min_batch=4, sequence=True,
+                                           min_seq=16))
+    assert n == 4
+    assert padded.features.shape == (4, 2, 64)
+    assert padded.labels.shape == (4, 2, 64)
+    # features mask materialized (ones on real steps), zero on padding
+    assert padded.features_mask.shape == (4, 64)
+    assert padded.features_mask[:, :T].all()
+    assert not padded.features_mask[:, T:].any()
+    assert not padded.labels_mask[:, T:].any()
+
+
+def test_pad_dataset_sequence_requires_mask_for_time_labels():
+    ds = DataSet(np.ones((4, 2, 37), np.float32),
+                 np.ones((4, 2, 37), np.float32))
+    with pytest.raises(ValueError, match="labels_mask"):
+        pad_dataset(ds, BucketSpec(sequence=True, min_seq=16))
+
+
+def test_pad_multidataset_pads_every_stream():
+    mds = MultiDataSet([np.ones((9, 3)), np.ones((9, 2))],
+                       [np.ones((9, 4)), np.ones((9, 1))])
+    padded, n = pad_multidataset(mds, BucketSpec(min_batch=16))
+    assert n == 9
+    assert all(f.shape[0] == 16 for f in padded.features)
+    assert all(y.shape[0] == 16 for y in padded.labels)
+    for m in padded.labels_masks:
+        assert m[:9].all() and not m[9:].any()
+
+
+def test_pad_multidataset_aligned_batch_materializes_masks():
+    """Signature stability, MultiDataSet form: a bucket-aligned batch still
+    gets the all-ones labels masks padding would have created — otherwise
+    aligned batches (maskless) and padded tails (masked) mint TWO
+    executables for one workload, the exact churn bucketing exists to
+    kill (pad_dataset already pins this for the DataSet path)."""
+    mds = MultiDataSet([np.ones((16, 3))], [np.ones((16, 4))])
+    padded, n = pad_multidataset(mds, BucketSpec(min_batch=16))
+    assert n == 16
+    assert padded.features[0].shape[0] == 16
+    assert len(padded.labels_masks) == 1
+    assert padded.labels_masks[0].shape == (16,)
+    assert padded.labels_masks[0].all()
+    # existing masks pass through untouched — no double-materialize
+    again, _ = pad_multidataset(padded, BucketSpec(min_batch=16))
+    assert again is padded
+
+
+# ------------------------------------------------------------- loss parity
+
+
+def test_lenet_bucketed_loss_parity_1e6():
+    """ISSUE 12 acceptance: pad-to-bucket training == unbucketed training
+    to 1e-6 on LeNet — per-step losses AND final params."""
+    rs = np.random.RandomState(0)
+    X = rs.rand(45, 1, 12, 12).astype(np.float32)
+    Y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, 45)]
+
+    plain, bucketed = _lenet(), _lenet()
+    bucketed.set_bucketing(BucketSpec(min_batch=16))
+    losses_p, losses_b = [], []
+    for lo in range(0, 45, 16):  # batches of 16, 16, 13 — ragged tail
+        ds = DataSet(X[lo:lo + 16], Y[lo:lo + 16])
+        plain._fit_batch(DataSet(X[lo:lo + 16], Y[lo:lo + 16]))
+        bucketed._fit_batch(ds)
+        losses_p.append(plain.score_)
+        losses_b.append(bucketed.score_)
+    np.testing.assert_allclose(losses_b, losses_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(bucketed.params().numpy()),
+                               np.asarray(plain.params().numpy()), atol=1e-6)
+
+
+def test_graph_bucketed_loss_parity():
+    def build():
+        g = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+             .graph_builder().add_inputs("in")
+             .set_input_types(InputType.feed_forward(6)))
+        g.add_layer("d", DenseLayer(n_out=12, activation="relu"), "in")
+        g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "d")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+    rs = np.random.RandomState(1)
+    X = rs.randn(21, 6).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 21)]
+    plain, bucketed = build(), build()
+    bucketed.set_bucketing(BucketSpec(min_batch=16))
+    lp, lb = [], []
+    for lo in range(0, 21, 16):
+        plain.fit(DataSet(X[lo:lo + 16], Y[lo:lo + 16]))
+        bucketed.fit(DataSet(X[lo:lo + 16], Y[lo:lo + 16]))
+        lp.append(plain.score_)
+        lb.append(bucketed.score_)
+    np.testing.assert_allclose(lb, lp, atol=1e-6)
+    assert bucketed.last_batch_size == 5  # true tail, not the padded 16
+
+
+def test_parallel_trainer_bucketing_keeps_mesh_divisibility():
+    """ParallelTrainer folds the mesh data-axis size into the bucket
+    multiple: bucketed batches never take the remainder-fallback path, and
+    loss still matches unbucketed single-device training."""
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    rs = np.random.RandomState(2)
+    X = rs.randn(19, 8).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 19)]
+
+    plain = _dense_net(seed=3)
+    plain._fit_batch(DataSet(X, Y))
+
+    net = _dense_net(seed=3)
+    trainer = ParallelTrainer(net, bucketing=BucketSpec(min_batch=8))
+    trainer._fit_batch(DataSet(X, Y))
+    assert net.last_batch_size == 19
+    np.testing.assert_allclose(float(net.score_), float(plain.score_),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(net.params().numpy()),
+                               np.asarray(plain.params().numpy()), atol=1e-6)
+
+
+# ----------------------------------------------------- compile-churn pins
+
+
+def test_varying_batch_tail_compiles_flat_after_warmup():
+    """The churn workload the tentpole exists for: ragged tails mint ONE
+    signature (one executable) with bucketing on — and would mint one per
+    distinct tail without it."""
+    from deeplearning4j_tpu.monitoring import RecompileWatchdog
+
+    rs = np.random.RandomState(4)
+    X = rs.randn(64, 8).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 64)]
+
+    with RecompileWatchdog() as wd:
+        net = _dense_net(seed=5).set_bucketing(BucketSpec(min_batch=32))
+        for tail in (32, 17, 9, 23, 31, 32):
+            net._fit_batch(DataSet(X[:tail], Y[:tail]))
+        assert wd.stats()["signatures"]["MultiLayerNetwork.train_step"] == 1
+
+    with RecompileWatchdog() as wd:
+        churner = _dense_net(seed=5)  # no bucketing: one signature per tail
+        for tail in (32, 17, 9, 23):
+            churner._fit_batch(DataSet(X[:tail], Y[:tail]))
+        assert wd.stats()["signatures"]["MultiLayerNetwork.train_step"] == 4
+
+
+def test_sequence_bucketing_single_signature_for_ragged_time():
+    """Variable-length text: T in {11, 13, 16} all pad to one seq bucket
+    (and one batch bucket) — one signature."""
+    from deeplearning4j_tpu.monitoring import RecompileWatchdog
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(LSTM(n_in=3, n_out=8))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_bucketing(BucketSpec(min_batch=4, sequence=True, min_seq=16))
+    rs = np.random.RandomState(6)
+    with RecompileWatchdog() as wd:
+        for T in (11, 13, 16):
+            x = rs.randn(3, 3, T).astype(np.float32)
+            y = np.zeros((3, 2, T), np.float32)
+            y[:, 0] = 1.0
+            net._fit_batch(DataSet(x, y, None, np.ones((3, T), np.float32)))
+        assert wd.stats()["signatures"]["MultiLayerNetwork.train_step"] == 1
+    assert net.last_batch_size == 3
+
+
+def test_tbptt_accepts_per_example_bucket_mask():
+    """Batch bucketing creates a [B] mask; the tbptt path broadcasts it to
+    its per-timestep [B, T] form — padded rows contribute zero segments."""
+    def build():
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(LSTM(n_in=2, n_out=4))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(2))
+                .t_bptt_length(4)
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(7)
+    x = rs.randn(3, 2, 8).astype(np.float32)
+    y = np.zeros((3, 2, 8), np.float32)
+    y[:, 1] = 1.0
+    plain = build()
+    plain._fit_batch(DataSet(x, y))
+    bucketed = build().set_bucketing(BucketSpec(min_batch=4))
+    bucketed._fit_batch(DataSet(x, y))
+    assert bucketed.last_batch_size == 3
+    np.testing.assert_allclose(float(bucketed.score_), float(plain.score_),
+                               atol=1e-6)
+
+
+# ------------------------------------------------- correctness guard rails
+
+
+def test_set_bucketing_refuses_batchnorm():
+    """The labels mask keeps padded rows out of the LOSS, but BN batch
+    statistics are computed over every row of the padded batch — phantom
+    zero rows would silently change training vs unbucketed, so
+    set_bucketing refuses instead of breaking the parity contract."""
+    from deeplearning4j_tpu.nn.conf import BatchNormalization
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf)
+    with pytest.raises(ValueError, match="BatchNormalization"):
+        net.set_bucketing(BucketSpec(min_batch=32))
+    assert net._bucketing is None  # refusal leaves bucketing off
+    net.set_bucketing(None)  # disabling is always allowed
+
+
+def test_multiprocess_bucket_divergence_is_deterministic_error():
+    """Per-rank ragged tails that straddle a power-of-2 boundary (17 vs 16)
+    bucket to DIFFERENT sizes; MultiProcessTrainer's per-batch lockstep
+    check must turn that into a ValueError naming the sizes instead of a
+    hang in the first collective."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    from deeplearning4j_tpu.parallel.trainer import _check_lockstep_buckets
+
+    class _Patch:
+        def __init__(self, sizes):
+            self.sizes = sizes
+
+        def __enter__(self):
+            self._pc, self._ag = jax.process_count, multihost_utils.process_allgather
+            jax.process_count = lambda: 2
+            multihost_utils.process_allgather = \
+                lambda x: np.asarray(self.sizes, np.int32)
+            return self
+
+        def __exit__(self, *exc):
+            jax.process_count = self._pc
+            multihost_utils.process_allgather = self._ag
+
+    with _Patch([32, 16]):
+        with pytest.raises(ValueError, match=r"diverged.*\[32, 16\]"):
+            _check_lockstep_buckets(32)
+    with _Patch([32, 32]):
+        _check_lockstep_buckets(32)  # agreement passes
+    _check_lockstep_buckets(7)  # single-process: no collective, no-op
+
+
+def test_multiprocess_bucket_multiple_is_process_local():
+    """Each rank buckets its LOCAL shard: with 8 global devices over 2
+    processes the multiple is 4, so a 3-row local tail pads to 4 — folding
+    the GLOBAL axis size in would over-pad it to 8 (2x the phantom rows,
+    every ragged step, on every rank)."""
+    import jax
+
+    from deeplearning4j_tpu.parallel import build_mesh
+    from deeplearning4j_tpu.parallel.trainer import MultiProcessTrainer
+
+    net = _dense_net().set_bucketing(BucketSpec())
+    trainer = MultiProcessTrainer(net, mesh=build_mesh(data=8))
+    orig = jax.process_count
+    jax.process_count = lambda: 2
+    try:
+        assert trainer._bucket_multiple() == 4
+        ds, n = trainer._bucket_for_mesh(
+            DataSet(np.ones((3, 8), np.float32),
+                    np.ones((3, 4), np.float32)))
+        assert n == 3
+        assert ds.features.shape[0] == 4
+    finally:
+        jax.process_count = orig
+    # single-process: the whole data axis, exactly as before
+    assert trainer._bucket_multiple() == 8

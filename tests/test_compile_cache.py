@@ -1,0 +1,369 @@
+"""Persistent compiled-executable cache (ISSUE 12 tentpole layer 2).
+
+Acceptance pins:
+- the restart contract: two PROCESSES sharing one TDL_COMPILE_CACHE_DIR —
+  the second pays ZERO per-fn compiles after warmup and shows cache-hit
+  counters as evidence;
+- per-fn hit/miss attribution through the note_signature thread
+  announcements;
+- executables restored from disk are NOT counted as compiles (the
+  backend_compile duration event wraps jax's cache retrieval too — pinned
+  here so a jax upgrade changing that ordering fails loudly);
+- env contract plumbing: GangSupervisor hands every incarnation a STABLE
+  ``workdir/compile_cache``; the serving builder takes an explicit dir;
+- warmup completeness satellite: with the cache present the executor warms
+  EVERY ParallelInference bucket, not just the smallest.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import compile_cache
+from deeplearning4j_tpu.common.bucketing import bucket_ladder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def enabled_cache(tmp_path):
+    """Enable the persistent cache at a tmp dir for one test, restoring the
+    disabled state after (the cache is process-wide jax config — leaking it
+    would slow and dirty every later test)."""
+    d = str(tmp_path / "compile_cache")
+    compile_cache.enable(d)
+    try:
+        yield d
+    finally:
+        compile_cache.disable()
+
+
+def _tiny_net():
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _fit_some(net, steps=3):
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, 8).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 32)]
+    for _ in range(steps):
+        net._fit_batch(DataSet(X, Y))
+
+
+# ----------------------------------------------------------- in-process
+
+
+def test_miss_then_hit_attributed_per_fn(enabled_cache):
+    """First compile = miss (written to disk); after dropping jax's
+    in-memory caches the same dispatch = hit, both attributed to the
+    announcing fit loop — and a restored executable never increments the
+    compile counters."""
+    import jax
+
+    from deeplearning4j_tpu.monitoring import RecompileWatchdog, compilecache
+
+    net = _tiny_net()
+    _fit_some(net)
+    s1 = compilecache.stats()
+    assert s1["misses"].get("MultiLayerNetwork.train_step") == 1
+    assert s1["bytes"] > 0
+    assert os.listdir(enabled_cache)  # executables actually on disk
+
+    with RecompileWatchdog() as wd:
+        jax.clear_caches()
+        net2 = _tiny_net()
+        _fit_some(net2)
+        s2 = compilecache.stats()
+        # every announced executable restored, none compiled. (A couple of
+        # anonymous helper jits — threefry seeding etc. — can legitimately
+        # get fresh cache keys after an in-process clear_caches; the REAL
+        # restart contract, zero misses of any kind in a fresh process, is
+        # pinned by test_compiles_flat_across_process_restart below.)
+        assert sum(s2["hits"].values()) > sum(s1["hits"].values())
+        assert s2["hits"].get("MultiLayerNetwork.train_step", 0) >= 1
+        named = {k: v for k, v in wd.stats()["per_fn_compiles"].items()
+                 if k != "_unattributed"}
+        assert named == {}, (
+            f"cache restores must not count as compiles: {named}")
+
+
+def test_hit_restore_spends_the_watchdog_announcement(enabled_cache):
+    """A cache-hit restore must CLEAR the per-watchdog announcement, not
+    just skip the compile counters: the restored fn's announcement is spent
+    by the restore, so the thread's next UNANNOUNCED compile (an anonymous
+    helper jit within the 120s attribution window) stays _unattributed
+    instead of minting a phantom tdl_xla_compiles_total{fn=train_step} —
+    the exact counter the flat-across-restart acceptance reads."""
+    import jax
+
+    from deeplearning4j_tpu.monitoring import RecompileWatchdog
+
+    net = _tiny_net()
+    _fit_some(net)  # misses written to disk
+
+    with RecompileWatchdog() as wd:
+        jax.clear_caches()
+        net2 = _tiny_net()
+        _fit_some(net2)  # hit-restores; last announcement = train_step
+        # fresh anonymous jit on the SAME thread: a real compile nothing
+        # announced
+        jax.jit(lambda x: x * 2.0 + 1.0)(np.ones(3, np.float32))
+        stats = wd.stats()["per_fn_compiles"]
+        assert stats.get("MultiLayerNetwork.train_step", 0) == 0, stats
+        assert stats.get("_unattributed", 0) >= 1, stats
+
+
+def test_cache_bytes_gauge_tracks_directory(enabled_cache):
+    from deeplearning4j_tpu.monitoring import get_registry
+
+    from deeplearning4j_tpu.monitoring import compilecache
+
+    net = _tiny_net()
+    _fit_some(net, steps=1)
+    # the miss event fires just before jax writes the entry, so the gauge
+    # trails the disk by one entry until refreshed
+    n = compilecache.refresh_bytes()
+    g = get_registry().get("tdl_compile_cache_bytes")
+    assert g is not None
+    assert g.snapshot()["series"][0]["value"] == n
+    assert n == compile_cache.cache_size_bytes(enabled_cache) > 0
+
+
+def test_enable_is_idempotent_and_disable_resets(tmp_path):
+    d = str(tmp_path / "cc")
+    assert compile_cache.enable(d) == compile_cache.enable(d)
+    assert compile_cache.enabled() and compile_cache.cache_dir() == \
+        os.path.abspath(d)
+    compile_cache.disable()
+    assert not compile_cache.enabled()
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir is None
+
+
+# ------------------------------------------------- the restart acceptance
+
+
+_RESTART_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["TDL_COMPILE_CACHE_DIR"] = sys.argv[1]
+    import numpy as np
+    from deeplearning4j_tpu.monitoring import RecompileWatchdog, compilecache
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    wd = RecompileWatchdog().install()
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, 8).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 32)]
+    for _ in range(4):
+        net._fit_batch(DataSet(X, Y))
+    stats = compilecache.stats()
+    print(json.dumps({
+        "per_fn_compiles": wd.stats()["per_fn_compiles"],
+        "hits": stats["hits"], "misses": stats["misses"],
+        "bytes": stats["bytes"],
+    }))
+""")
+
+
+def _run_restart_worker(cache_dir):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-c", _RESTART_WORKER, cache_dir],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_compiles_flat_across_process_restart(tmp_path):
+    """ISSUE 12 acceptance: same TDL_COMPILE_CACHE_DIR across two processes
+    ⇒ the second process records ZERO compiles per fn (every executable —
+    the announced train step AND the helper jits — restores from disk),
+    with cache-hit counters as the evidence."""
+    cache_dir = str(tmp_path / "compile_cache")
+    run1 = _run_restart_worker(cache_dir)
+    assert run1["per_fn_compiles"].get("MultiLayerNetwork.train_step") == 1
+    assert run1["misses"].get("MultiLayerNetwork.train_step") == 1
+    assert run1["bytes"] > 0
+
+    run2 = _run_restart_worker(cache_dir)
+    assert run2["per_fn_compiles"] == {}, (
+        f"process restart recompiled: {run2['per_fn_compiles']}")
+    assert sum(run2["hits"].values()) > 0
+    assert run2["hits"].get("MultiLayerNetwork.train_step", 0) >= 1
+    assert run2["misses"] == {}
+
+
+# ------------------------------------------------------- env contract
+
+
+def test_supervisor_child_env_carries_stable_compile_cache_dir(tmp_path):
+    from deeplearning4j_tpu.parallel.supervisor import GangSupervisor
+
+    sup = GangSupervisor("tests.mp_workers:dp_train", n_processes=1,
+                         workdir=str(tmp_path))
+    env1 = sup._child_env(0, str(tmp_path / "hb_0"))
+    env2 = sup._child_env(1, str(tmp_path / "hb_1"))
+    expected = os.path.join(str(tmp_path), "compile_cache")
+    # STABLE across attempts: incarnation N+1 must find incarnation N's
+    # executables (flight dirs, by contrast, are per-attempt)
+    assert env1[compile_cache.ENV_DIR] == expected
+    assert env2[compile_cache.ENV_DIR] == expected
+    assert sup.compile_cache_dir == expected
+    # an operator override through extra_env wins
+    sup2 = GangSupervisor("tests.mp_workers:dp_train", n_processes=1,
+                          workdir=str(tmp_path / "w2"),
+                          extra_env={compile_cache.ENV_DIR: "/elsewhere"})
+    assert sup2._child_env(0, str(tmp_path / "hb"))[
+        compile_cache.ENV_DIR] == "/elsewhere"
+    assert sup2.compile_cache_dir == "/elsewhere"
+
+
+def test_multiprocess_cpu_gang_skips_cache(tmp_path, monkeypatch):
+    """Reloaded XLA:CPU executables carrying gloo collectives segfault
+    (observed: respawned CPU gangs died -11/-6 on their first restored
+    step), so the env contract is deliberately ignored on multi-process
+    CPU — TPU gangs and single-process runs use the cache normally."""
+    from jax._src import distributed
+
+    monkeypatch.setenv(compile_cache.ENV_DIR, str(tmp_path / "cc"))
+    monkeypatch.setattr(distributed.global_state, "client", object(),
+                        raising=False)
+    assert compile_cache.maybe_enable_from_env() is None
+    assert not compile_cache.enabled()
+    # same process, distributed torn down (single-process again): enabled
+    monkeypatch.setattr(distributed.global_state, "client", None,
+                        raising=False)
+    try:
+        assert compile_cache.maybe_enable_from_env() is not None
+        assert compile_cache.enabled()
+    finally:
+        compile_cache.disable()
+
+
+def test_env_enable_revoked_when_gang_turns_multiprocess(tmp_path,
+                                                         monkeypatch):
+    """The first net/executor can be built BEFORE jax.distributed
+    initializes — the safety probe still answers 'safe' and the env var
+    enables the cache. The next entry point after distributed init must
+    REVOKE it: a respawned gang restoring XLA:CPU collective executables
+    from that early enable segfaults (-11/-6 at the first restored step)."""
+    from jax._src import distributed
+
+    monkeypatch.setenv(compile_cache.ENV_DIR, str(tmp_path / "cc"))
+    monkeypatch.setattr(distributed.global_state, "client", None,
+                        raising=False)
+    try:
+        assert compile_cache.maybe_enable_from_env() is not None  # pre-init
+        assert compile_cache.enabled()
+        monkeypatch.setattr(distributed.global_state, "client", object(),
+                            raising=False)
+        assert compile_cache.maybe_enable_from_env() is None
+        assert not compile_cache.enabled()
+    finally:
+        compile_cache.disable()
+
+
+def test_explicit_enable_wins_over_env(tmp_path, monkeypatch):
+    """An entry point's maybe_enable_from_env must NOT re-point a cache the
+    serving builder (or operator) explicitly enabled — executables would be
+    silently stranded in a directory a restarted replica never reads."""
+    explicit = str(tmp_path / "explicit")
+    monkeypatch.setenv(compile_cache.ENV_DIR, str(tmp_path / "env"))
+    try:
+        compile_cache.enable(explicit)
+        assert compile_cache.maybe_enable_from_env() == \
+            os.path.abspath(explicit)
+        assert compile_cache.cache_dir() == os.path.abspath(explicit)
+    finally:
+        compile_cache.disable()
+
+
+def test_server_builder_enables_explicit_cache_dir(tmp_path):
+    from deeplearning4j_tpu.serving import JsonModelServer
+
+    d = str(tmp_path / "serving_cache")
+    try:
+        server = (JsonModelServer.Builder(_tiny_net())
+                  .compile_cache_dir(d).build())
+        assert compile_cache.cache_dir() == os.path.abspath(d)
+        assert os.path.isdir(d)
+        assert server.warmup_all_buckets is None  # auto: cache on → ladder
+    finally:
+        compile_cache.disable()
+
+
+# ------------------------------------------- warmup completeness satellite
+
+
+def test_executor_warms_every_bucket_with_cache_present(enabled_cache):
+    """Satellite: pre-ISSUE-12 only the smallest bucket was warmed and the
+    first large coalesced batch ate a compile mid-traffic; with the cache
+    enabled the whole ladder is warmed (cheap on cache hit)."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving.executor import BatchingInferenceExecutor
+
+    net = _tiny_net()
+    pi = ParallelInference(net, batch_limit=16)
+    warmed = []
+    orig = pi.output_batched
+    pi.output_batched = lambda xs: (warmed.append(
+        sum(x.shape[0] for x in xs)), orig(xs))[1]
+    ex = BatchingInferenceExecutor(
+        parallel_inference=pi, max_batch_rows=64,
+        warmup_input=np.zeros((1, 8), np.float32)).start()
+    try:
+        assert ex.wait_warm(120)
+        assert warmed == bucket_ladder(64, min_bucket=16,
+                                       multiple=pi._ndata)
+    finally:
+        ex.stop()
+
+
+def test_executor_warms_smallest_bucket_without_cache():
+    """Historical default preserved: no cache, no opt-in ⇒ one warmup
+    forward (compiling the whole ladder up front would tax every cold
+    start for buckets that may never arrive)."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving.executor import BatchingInferenceExecutor
+
+    net = _tiny_net()
+    pi = ParallelInference(net, batch_limit=16)
+    warmed = []
+    orig = pi.output_batched
+    pi.output_batched = lambda xs: (warmed.append(
+        sum(x.shape[0] for x in xs)), orig(xs))[1]
+    ex = BatchingInferenceExecutor(
+        parallel_inference=pi, max_batch_rows=64,
+        warmup_input=np.zeros((1, 8), np.float32)).start()
+    try:
+        assert ex.wait_warm(120)
+        assert len(warmed) == 1
+    finally:
+        ex.stop()
