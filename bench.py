@@ -165,6 +165,14 @@ def _scale(on_tpu):
                                 slo_target=0.99),
             "bert_large_fsdp": dict(batch=8, seq=128, steps=8, warmup=2,
                                     large=True, tp=1),
+            "serving_pool": dict(slots=8, duration_s=12.0, base_rate=60.0,
+                                 burst_mult=10.0, max_new=16, clients=48,
+                                 max_new_mix=(4, 8, 16, 48),
+                                 d_model=256, n_layers=4, n_heads=8,
+                                 d_ff=1024, vocab=8192, max_len=256,
+                                 queue=256, replicas=2,
+                                 pool_duration_s=8.0, pool_rate=30.0,
+                                 slo_threshold_ms=1000.0, slo_target=0.99),
             "compile_cache": dict(features=64, classes=8, batch_limit=16,
                                   max_rows=128, fit_batch=128, fit_steps=4,
                                   flash=dict(B=1, H=12, T=8192, D=64,
@@ -184,6 +192,13 @@ def _scale(on_tpu):
                             slo_target=0.99),
         "bert_large_fsdp": dict(batch=2, seq=64, steps=2, warmup=1,
                                 large=False, tp=1),
+        "serving_pool": dict(slots=4, duration_s=5.0, base_rate=24.0,
+                             burst_mult=6.0, max_new=8, clients=24,
+                             max_new_mix=(2, 4, 8, 24),
+                             d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                             vocab=256, max_len=64, queue=128, replicas=2,
+                             pool_duration_s=4.0, pool_rate=12.0,
+                             slo_threshold_ms=2000.0, slo_target=0.95),
         "compile_cache": dict(features=16, classes=4, batch_limit=8,
                               max_rows=32, fit_batch=32, fit_steps=2,
                               flash=dict(B=1, H=2, T=128, D=16, trials=1)),
@@ -928,6 +943,17 @@ def bench_fsdp(p):
 # ------------------------------------------------------------------- serving
 
 
+def _latency_ms(latencies):
+    """Shared nearest-rank p50/p99 over a SORTED seconds list — the serving
+    and serving_pool replays must report identically-computed percentiles."""
+    n = len(latencies)
+    return {
+        "p50_ms": round(latencies[n // 2] * 1e3, 2) if n else None,
+        "p99_ms": round(latencies[min(n - 1, int(0.99 * n))] * 1e3, 2)
+        if n else None,
+    }
+
+
 def bench_serving(p):
     """ISSUE 5: serving throughput + tail latency through the full stack —
     JsonModelClient → HTTP → bounded admission queue → micro-batching
@@ -998,8 +1024,7 @@ def bench_serving(p):
         "value": round(n / elapsed, 1) if elapsed else 0.0,
         "unit": "req/s",
         "clients": p["clients"], "completed": n, "errors": errors[0],
-        "p50_ms": round(latencies[n // 2] * 1e3, 2) if n else None,
-        "p99_ms": round(latencies[min(n - 1, int(0.99 * n))] * 1e3, 2) if n else None,
+        **_latency_ms(latencies),
         "mean_batch_rows": round(total / count, 2) if count else None,
         "batch_limit": p["batch_limit"],
     }
@@ -1096,6 +1121,219 @@ def bench_serving_slo(p):
             "burn_rate": serving_lat.get("burn_rate"),
         },
         "alerts_fired_during_replay": sorted(fired),
+        "trace": spec.to_dict(),
+    }
+
+
+# -------------------------------------------------------------- serving pool
+
+
+def _pool_transformer_cfg(p):
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        causal=True, dropout=0.0, attn_impl="xla",
+        vocab_size=p["vocab"], max_len=p["max_len"], d_model=p["d_model"],
+        n_heads=p["n_heads"], n_layers=p["n_layers"], d_ff=p["d_ff"],
+        param_dtype=jnp.float32, compute_dtype=jnp.float32)
+
+
+def _serving_pool_replica():
+    """Replica target (``bench:_serving_pool_replica``) for the serving_pool
+    bench: a real KV-cache transformer slot pool behind a generative
+    JsonModelServer, shaped by the TDL_BENCH_POOL_CFG env json. Warmup
+    restores from the pool's shared compile cache — which is exactly what
+    makes the pool's scale-up cheap enough to be alert-driven."""
+    import jax
+    import numpy as _np
+
+    from deeplearning4j_tpu.models import transformer as tfm
+    from deeplearning4j_tpu.serving import JsonModelServer
+
+    p = json.loads(os.environ["TDL_BENCH_POOL_CFG"])
+    cfg = _pool_transformer_cfg(p)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    pool = tfm.DecodeSlotPool(params, cfg, slots=p["slots"])
+    return JsonModelServer(
+        None, port=0, generative_session=pool,
+        default_max_new_tokens=p["max_new"], max_queue=p["queue"],
+        warmup_input=_np.asarray([1, 2, 3], _np.int32))
+
+
+def _replay_generative_executor(ex, spec, prompt_fn, max_new_fn, clients):
+    """Open-loop replay of a TraceSpec's arrival schedule straight into a
+    generative executor (no HTTP): per-request client-side latency, ok
+    count, and wall — the measurement both batching policies share.
+    ``max_new_fn(i)`` draws each request's generation budget: HETEROGENEOUS
+    lengths are the realistic workload, and exactly what static padded
+    batching pays for (a short ride queued behind a long batch member)."""
+    import threading
+
+    arrivals = spec.arrivals()
+    results = [None] * len(arrivals)
+    next_idx = [0]
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def worker():
+        while True:
+            with lock:
+                i = next_idx[0]
+                if i >= len(arrivals):
+                    return
+                next_idx[0] = i + 1
+            delay = arrivals[i][0] - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            sent = time.perf_counter()
+            try:
+                fut = ex.submit(prompt_fn(i), max_new_tokens=max_new_fn(i),
+                                request_id=f"bench-pool-{i}")
+                ok = fut.wait(120.0) and fut.error is None
+            except Exception:
+                ok = False
+            results[i] = {"ok": bool(ok),
+                          "latency": time.perf_counter() - sent}
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    lat = sorted(r["latency"] for r in results if r and r["ok"])
+    return {
+        "offered": len(arrivals),
+        "ok": len(lat),
+        "elapsed_s": round(elapsed, 3),
+        **_latency_ms(lat),
+    }
+
+
+def bench_serving_pool(p):
+    """ISSUE 13: the elastic-generative-serving evidence, in two phases.
+
+    Phase 1 — continuous vs STATIC batching at equal load: the same seeded
+    diurnal+burst generative trace replayed into a KV-cache slot pool twice,
+    once with iteration-level admission (continuous) and once admitting only
+    into an empty pool (static padded batching, the DL4J-era policy). The
+    acceptance claim is measured, not assumed: p99 strictly lower AND
+    tokens/s no worse, with mean decode-slot occupancy reported.
+
+    Phase 2 — the replica pool: N real transformer replicas (subprocesses,
+    shared persistent compile cache) behind the least-loaded router replay a
+    trace through HTTP, then a manual scale-up measures time-to-ready for a
+    NEW replica warming from the cache — the number that prices
+    alert-driven autoscaling."""
+    import jax
+
+    from deeplearning4j_tpu.models import transformer as tfm
+    from deeplearning4j_tpu.serving import (GenerativeInferenceExecutor,
+                                            LoadGenerator, ServingPool,
+                                            TraceSpec)
+
+    cfg = _pool_transformer_cfg(p)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    rs = np.random.RandomState(7)
+    prompt_lens = (3, 5, 9, 14)
+    prompts = [rs.randint(1, p["vocab"], n).tolist() for n in prompt_lens]
+    mix = tuple(p.get("max_new_mix") or (p["max_new"],))
+
+    def prompt_fn(i):
+        return prompts[i % len(prompts)]
+
+    def max_new_fn(i):
+        return mix[i % len(mix)]
+
+    dur = p["duration_s"]
+    spec = TraceSpec(duration_s=dur, base_rate=p["base_rate"], seed=0,
+                     diurnal_amplitude=0.4,
+                     bursts=((0.5 * dur, 0.15 * dur, p["burst_mult"]),))
+    phase1 = {}
+    for mode, continuous in (("continuous", True), ("static", False)):
+        pool = tfm.DecodeSlotPool(params, cfg, slots=p["slots"])
+        ex = GenerativeInferenceExecutor(
+            pool, continuous=continuous, max_queue=p["queue"],
+            default_max_new_tokens=max(mix),
+            warmup_prompt=np.asarray([1, 2, 3], np.int32)).start()
+        ex.wait_warm(120.0)
+        try:
+            report = _replay_generative_executor(
+                ex, spec, prompt_fn, max_new_fn, p["clients"])
+        finally:
+            ex.stop(drain=True)
+        stats = ex.stats()
+        report["tokens_per_s"] = (round(stats["tokens"] / report["elapsed_s"], 1)
+                                  if report["elapsed_s"] else 0.0)
+        report["mean_slot_occupancy"] = stats["mean_slot_occupancy"]
+        report["decode_steps"] = stats["steps"]
+        phase1[mode] = report
+
+    cont, stat = phase1["continuous"], phase1["static"]
+    p99_ratio = (round(stat["p99_ms"] / cont["p99_ms"], 2)
+                 if cont.get("p99_ms") and stat.get("p99_ms") else None)
+
+    # ---- phase 2: the replica pool over HTTP -----------------------------
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="tdl_bench_pool_")
+    pool = ServingPool(
+        "bench:_serving_pool_replica", replicas=p["replicas"],
+        min_replicas=1, max_replicas=p["replicas"] + 1, workdir=workdir,
+        extra_env={"TDL_BENCH_POOL_CFG": json.dumps(p)})
+    pool_report = {"replicas": p["replicas"]}
+    try:
+        pool.start()
+        if not pool.wait_ready(300.0):
+            pool_report["error"] = "pool never became ready"
+        else:
+            pdur = p["pool_duration_s"]
+            pool_spec = TraceSpec(
+                duration_s=pdur, base_rate=p["pool_rate"], seed=1,
+                diurnal_amplitude=0.3,
+                bursts=((0.5 * pdur, 0.2 * pdur, p["burst_mult"]),))
+            replay = LoadGenerator(
+                pool_spec, pool.port, n_clients=min(16, p["clients"]),
+                payload=prompts[0], slo_threshold_ms=p["slo_threshold_ms"],
+                slo_target=p["slo_target"]).run()
+            pool_report.update({
+                "offered": replay["offered"],
+                "outcomes": replay["outcomes"],
+                "p99_ms": replay["latency_ms"]["p99"],
+                "slo_attainment": replay["slo"]["attainment"],
+                "burn_rate_worst_window": replay["slo"]["burn_rate_worst_window"],
+            })
+            # manual scale-up: time to a READY extra replica, warmed from
+            # the shared persistent compile cache (why respawn is cheap)
+            t0 = time.perf_counter()
+            pool.scale_to(p["replicas"] + 1, reason="bench scale probe")
+            deadline = time.monotonic() + 300.0
+            while (pool.ready_count < p["replicas"] + 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            pool_report["scale_up_ready_s"] = round(
+                time.perf_counter() - t0, 2)
+            pool_report["scaled_ready"] = pool.ready_count
+            pool.scale_to(p["replicas"], reason="bench scale probe done")
+            pool_report["replica_states"] = {
+                str(k): v for k, v in pool.replica_states().items()}
+    finally:
+        pool.stop()
+
+    return {
+        "metric": "serving_pool_continuous_tokens_per_sec",
+        "value": cont["tokens_per_s"],
+        "unit": "tokens/s",
+        "slots": p["slots"], "max_new_tokens": p["max_new"],
+        "continuous": cont,
+        "static": stat,
+        # the acceptance pair: >1.0 means continuous strictly beat static
+        # on p99; tokens/s comparison is read off the two rows directly
+        "static_over_continuous_p99": p99_ratio,
+        "pool": pool_report,
         "trace": spec.to_dict(),
     }
 
@@ -1276,6 +1514,7 @@ def bench_compile_cache(p):
 BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "w2v": bench_w2v, "bert": bench_bert, "serving": bench_serving,
            "serving_slo": bench_serving_slo, "bert_large_fsdp": bench_fsdp,
+           "serving_pool": bench_serving_pool,
            "compile_cache": bench_compile_cache}
 
 

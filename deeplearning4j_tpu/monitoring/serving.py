@@ -22,6 +22,28 @@ live, not only at the server)::
     tdl_client_request_seconds{outcome}     client-observed request wall time
                                             (retries included), by outcome
     tdl_client_retries_total{reason}        retry attempts by trigger
+
+Continuous-batching decode families (ISSUE 13 — the generative executor's
+per-step truth)::
+
+    tdl_decode_slot_occupancy               live sequences in the slot pool
+                                            at the last decode step (gauge)
+    tdl_decode_steps_total                  decode steps executed
+    tdl_decode_tokens_total                 tokens emitted across sequences
+    tdl_decode_admitted_total               sequences admitted into a slot
+    tdl_decode_evicted_total{reason}        sequences evicted mid-decode
+                                            (deadline, shutdown)
+
+Replica-pool families (ISSUE 13 — the ServingPool supervisor's view; the
+per-replica serving families above arrive with ``proc=replica{N}`` labels
+through the PR 7 spool merge)::
+
+    tdl_pool_size                           live replica processes (gauge)
+    tdl_pool_replica_state{replica,state}   1 for the replica's current
+                                            state (starting/ready/unready/
+                                            dead), 0 otherwise
+    tdl_pool_scale_events_total{direction}  autoscaler/manual resizes (up,
+                                            down)
 """
 
 from __future__ import annotations
@@ -58,6 +80,50 @@ def serving_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespa
             "tdl_inference_batch_size",
             "rows coalesced into one inference cycle",
             buckets=BATCH_SIZE_BUCKETS),
+    )
+
+
+def decode_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespace:
+    """Get-or-create the continuous-batching decode families (ISSUE 13).
+
+    Slot occupancy is the batching-efficiency headline: mean occupancy near
+    the pool size means the decode executable runs full; near 1 means the
+    pool is serving sequentially and static batching would do as well."""
+    r = registry if registry is not None else get_registry()
+    return SimpleNamespace(
+        slot_occupancy=r.gauge(
+            "tdl_decode_slot_occupancy",
+            "live sequences in the decode slot pool at the last step"),
+        steps=r.counter(
+            "tdl_decode_steps_total", "autoregressive decode steps executed"),
+        tokens=r.counter(
+            "tdl_decode_tokens_total",
+            "tokens emitted across all generated sequences"),
+        admitted=r.counter(
+            "tdl_decode_admitted_total",
+            "sequences admitted into a decode slot (prefilled)"),
+        evicted=r.counter(
+            "tdl_decode_evicted_total",
+            "sequences evicted mid-decode before finishing",
+            labels=("reason",)),
+    )
+
+
+def pool_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespace:
+    """Get-or-create the replica-pool families (ISSUE 13). The pool
+    supervisor owns these; per-replica serving metrics ride the spool merge
+    with ``proc=replica{N}`` labels instead."""
+    r = registry if registry is not None else get_registry()
+    return SimpleNamespace(
+        size=r.gauge("tdl_pool_size", "live serving replica processes"),
+        replica_state=r.gauge(
+            "tdl_pool_replica_state",
+            "1 for the replica's current state, 0 for its other states "
+            "(starting/ready/unready/dead)", labels=("replica", "state")),
+        scale_events=r.counter(
+            "tdl_pool_scale_events_total",
+            "replica-pool resizes by direction (autoscaler or manual)",
+            labels=("direction",)),
     )
 
 

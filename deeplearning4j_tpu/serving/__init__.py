@@ -12,14 +12,19 @@ executable). See docs/PARITY.md "Serving" for the DL4J mapping.
 """
 
 from .executor import (BatchingInferenceExecutor, DeadlineExceededError,
-                       ExecutorClosedError, InferenceFuture, QueueFullError)
+                       ExecutorClosedError, GenerationFuture,
+                       GenerativeInferenceExecutor, InferenceFuture,
+                       QueueFullError)
 from .json_server import JsonModelServer, JsonModelClient
 from .loadgen import Burst, LoadGenerator, TraceSpec, replay
+from .pool import PoolAutoscaler, ServingPool
 
 __all__ = [
     "JsonModelServer",
     "JsonModelClient",
     "BatchingInferenceExecutor",
+    "GenerativeInferenceExecutor",
+    "GenerationFuture",
     "InferenceFuture",
     "QueueFullError",
     "DeadlineExceededError",
@@ -28,4 +33,6 @@ __all__ = [
     "LoadGenerator",
     "TraceSpec",
     "replay",
+    "ServingPool",
+    "PoolAutoscaler",
 ]

@@ -51,6 +51,12 @@ from ..monitoring.serving import serving_metrics
 
 log = logging.getLogger(__name__)
 
+#: span payload keys that are NOT per-phase seconds. Every request_span
+#: recorder (the executor's abandoned paths, the HTTP layer's
+#: ``_record_span``) must split on this ONE set — a new extra added to
+#: only one site would land in ``phases={}`` as fake per-phase seconds.
+SPAN_EXTRA_KEYS = ("batch_rows", "steps", "step_ms")
+
 
 def span_sampled(request_id: Optional[str], sample_n: int) -> bool:
     """Deterministic request-span sampling: the SAME request id always
@@ -190,6 +196,7 @@ class BatchingInferenceExecutor:
         self._thread: Optional[threading.Thread] = None
         self._accepting = False
         self._stopping = False
+        self._drain_on_stop = True
         self._warm = threading.Event()
         self._depth_hwm = 0  # flight-recorded queue-depth high-watermark
 
@@ -220,6 +227,7 @@ class BatchingInferenceExecutor:
             if self._thread is None:
                 return
             self._stopping = True
+            self._drain_on_stop = drain  # generative loop cancels ACTIVE slots itself
             if not drain:
                 while self._q:
                     req = self._q.popleft()
@@ -267,6 +275,14 @@ class BatchingInferenceExecutor:
         sampled = span_sampled(request_id, self.span_sample_n)
         fut = InferenceFuture(arr, deadline, request_id=request_id,
                               sampled=sampled)
+        return self._admit(fut)
+
+    def _admit(self, fut: InferenceFuture) -> InferenceFuture:
+        """Shared bounded-queue admission (the generative executor admits
+        :class:`GenerationFuture`\\ s through the same path): queue-full ⇒
+        :class:`QueueFullError` + shed accounting + 429 span, closed ⇒
+        :class:`ExecutorClosedError`, else enqueue + depth/HWM telemetry."""
+        request_id, sampled = fut.request_id, fut.sampled
         with self._cv:
             if not self._accepting:
                 raise ExecutorClosedError("executor is not accepting requests")
@@ -429,10 +445,10 @@ class BatchingInferenceExecutor:
             abandoned = r.abandoned
         if abandoned and r.sampled:
             phases = dict(r.span or {})
-            rows = phases.pop("batch_rows", None)
+            extra = {k: phases.pop(k) for k in SPAN_EXTRA_KEYS if k in phases}
             flight.record("request_span", request_id=r.request_id,
                           outcome="shed_deadline", code=504, abandoned=True,
-                          phases=phases, batch_rows=rows)
+                          phases=phases, **extra)
 
     @staticmethod
     def _fill_spans(reqs: List[InferenceFuture], t_pop: float,
@@ -461,3 +477,354 @@ class BatchingInferenceExecutor:
             res.append(arr[off:off + x.shape[0]])
             off += x.shape[0]
         return res
+
+
+# -------------------------------------------- continuous batching (ISSUE 13)
+
+
+class GenerationFuture(InferenceFuture):
+    """One accepted GENERATIVE request: ``x`` holds the 1-D int32 prompt,
+    ``result`` the generated token ids (np.int32, EOS inclusive). The
+    executor appends into ``tokens`` as decode steps land."""
+
+    __slots__ = ("max_new_tokens", "tokens", "steps")
+
+    def __init__(self, x: np.ndarray, deadline: Optional[float],
+                 max_new_tokens: int, request_id: Optional[str] = None,
+                 sampled: bool = False):
+        super().__init__(x, deadline, request_id=request_id, sampled=sampled)
+        self.max_new_tokens = max_new_tokens
+        self.tokens: List[int] = []
+        self.steps = 0
+
+
+#: per-request decode-step timeline entries kept on a sampled span — enough
+#: to see stalls without letting a 2k-token generation bloat the flight ring
+_SPAN_STEP_CAP = 64
+
+
+class GenerativeInferenceExecutor(BatchingInferenceExecutor):
+    """Iteration-level (Orca-style) continuous batching over a decode slot
+    pool — the autoregressive counterpart of the micro-batching executor.
+
+    The inference thread runs the decode loop: at every STEP BOUNDARY it
+    admits queued requests into free KV slots (prompt prefill) and retires
+    finished sequences immediately — no request ever waits for the slowest
+    member of its batch, which is the whole p99 story for generative
+    traffic. Deadlines shed mid-decode through the existing 504 path
+    (the sequence is EVICTED, its slot freed the same step).
+
+    ``session`` is duck-typed (``models.transformer.DecodeSlotPool`` is the
+    real one): ``slots``, ``free_slots``, ``admit(prompt, max_new_tokens)
+    -> (slot, first_token)``, ``step() -> {slot: token}``, ``release(slot)``,
+    plus optional ``eos_id`` / ``max_len`` attributes.
+
+    ``continuous=False`` is the measured strawman: admission only into an
+    EMPTY pool, so a batch pads to its slowest member exactly like a
+    static padded batcher — ``bench.py serving_pool`` reports the two side
+    by side (never assume the policy, measure it — PAPERS.md 2207.00257).
+    """
+
+    def __init__(self, session, *, max_queue: int = 64,
+                 default_max_new_tokens: int = 32,
+                 default_deadline_ms: Optional[float] = None,
+                 eos_id: Optional[int] = None, continuous: bool = True,
+                 warmup_prompt=None, registry=None, span_sample_n: int = 1):
+        if default_max_new_tokens < 1:
+            raise ValueError(f"default_max_new_tokens must be >= 1, got "
+                             f"{default_max_new_tokens}")
+        super().__init__(model=session, max_queue=max_queue,
+                         default_deadline_ms=default_deadline_ms,
+                         warmup_input=warmup_prompt, registry=registry,
+                         span_sample_n=span_sample_n)
+        self.session = session
+        self.continuous = continuous
+        self.default_max_new_tokens = default_max_new_tokens
+        self.eos_id = eos_id if eos_id is not None else getattr(
+            session, "eos_id", None)
+        from ..monitoring.serving import decode_metrics
+
+        self._md = decode_metrics(registry)
+        # python-side aggregates for stats()/bench (registry counters are
+        # process-global; these are THIS executor's)
+        self._steps = 0
+        self._occupancy_sum = 0
+        self._tokens_out = 0
+        self._admitted = 0
+        self._evicted = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               request_id: Optional[str] = None,
+               max_new_tokens: Optional[int] = None) -> GenerationFuture:
+        """Admit one generation request. ``x`` is a 1-D token sequence (a
+        ``[1, T]`` row is accepted and squeezed). Raises ``ValueError`` on
+        non-integer tokens, a bad budget, or a prompt that cannot fit the
+        KV cache — caller faults answered at admission (HTTP 400), never a
+        500 from deep inside the decode loop."""
+        arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+        if arr.ndim == 2 and arr.shape[0] == 1:
+            arr = arr[0]
+        if arr.ndim != 1 or arr.shape[0] < 1:
+            raise ValueError("generative input must be one non-empty 1-D "
+                             f"token sequence (or a [1, T] row); got shape "
+                             f"{arr.shape}")
+        if not np.issubdtype(arr.dtype, np.integer):
+            rounded = np.rint(arr)
+            if not np.all(np.isfinite(arr)) or np.abs(arr - rounded).max() > 0:
+                raise ValueError("generative input must be integer token ids")
+            arr = rounded
+        # range-check BEFORE the int32 cast: a negative or 2**40 id would
+        # otherwise wrap/clamp inside the embedding gather and generate a
+        # plausible-looking 200 from the wrong embedding row
+        lo, hi = int(arr.min()), int(arr.max())
+        vocab = getattr(self.session, "vocab_size", None)
+        cap = (vocab - 1) if vocab is not None else np.iinfo(np.int32).max
+        if lo < 0 or hi > cap:
+            raise ValueError(
+                f"token ids must be in [0, {cap}] "
+                f"{'(vocab_size)' if vocab is not None else '(int32)'}; "
+                f"got [{lo}, {hi}]")
+        arr = arr.astype(np.int32)
+        mnt = (max_new_tokens if max_new_tokens is not None
+               else self.default_max_new_tokens)
+        if mnt < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
+        max_len = getattr(self.session, "max_len", None)
+        if max_len is not None and arr.shape[0] + mnt > max_len:
+            raise ValueError(
+                f"prompt of {arr.shape[0]} tokens + max_new_tokens={mnt} "
+                f"exceeds the {max_len}-position KV cache")
+        ms = (deadline_ms if deadline_ms is not None
+              else self.default_deadline_ms)
+        deadline = time.monotonic() + ms / 1000.0 if ms is not None else None
+        fut = GenerationFuture(
+            arr, deadline, mnt, request_id=request_id,
+            sampled=span_sampled(request_id, self.span_sample_n))
+        return self._admit(fut)
+
+    # -- decode loop -------------------------------------------------------
+
+    def _warmup(self) -> None:
+        """Compile (or cache-restore) the prefill + decode-step executables
+        before the first customer request: admit the warmup prompt, run one
+        decode step, release the slot."""
+        prompt = np.asarray(self._warmup_input, np.int32).reshape(-1)
+        slot, _ = self.session.admit(prompt, 2)
+        try:
+            self.session.step()
+        finally:
+            # a failed warmup step must not leak the slot: _loop swallows
+            # the exception and serves on, and at slots=1 a leaked slot is
+            # a permanent no-admissions busy-spin outage
+            try:
+                self.session.release(slot)
+            except Exception:
+                log.debug("warmup slot %d already freed", slot)
+        log.debug("generative warmup: prefill + decode step ready")
+
+    def _loop(self) -> None:
+        if self._warmup_input is not None:
+            try:
+                self._warmup()
+            except Exception:
+                log.exception("generative warmup failed — the first request "
+                              "will pay the XLA compiles instead")
+        self._warm.set()
+        active: Dict[int, GenerationFuture] = {}
+        while True:
+            with self._cv:
+                while not self._q and not active and not self._stopping:
+                    self._cv.wait()
+                stopping, drain = self._stopping, self._drain_on_stop
+                if stopping and not drain:
+                    # queued requests were already cancelled by stop();
+                    # active slots belong to this thread — cancel them here
+                    for slot, fut in active.items():
+                        self.session.release(slot)
+                        self._md.evicted.labels(reason="shutdown").inc()
+                        self._evicted += 1
+                        fut._resolve(error=ExecutorClosedError(
+                            "executor stopped mid-decode"))
+                    active.clear()
+                    self._md.slot_occupancy.set(0)
+                    return
+                if stopping and not self._q and not active:
+                    return
+                candidates: List[GenerationFuture] = []
+                if self.continuous or not active:
+                    free = self.session.free_slots
+                    while self._q and len(candidates) < free:
+                        candidates.append(self._q.popleft())
+                    self._m.queue_depth.set(len(self._q))
+            for fut in candidates:
+                self._admit_into_slot(fut, active)
+            if not active:
+                continue
+            self._decode_step(active)
+            aggregate.maybe_spool()  # replica's aggregated-/metrics spool
+
+    def _admit_into_slot(self, fut: GenerationFuture,
+                         active: Dict[int, GenerationFuture]) -> None:
+        now = time.monotonic()
+        self._m.queue_wait.observe(now - fut.enqueued_at)
+        if fut.deadline is not None and now >= fut.deadline:
+            # expired while queued: shed WITHOUT prefilling (same contract
+            # as the micro-batching executor's queue_expired path)
+            owns = fut._expire(DeadlineExceededError(
+                "deadline expired while queued"))
+            if owns:
+                self._m.shed.labels(reason="queue_expired").inc()
+                log.debug("request %s: expired in queue after %.3fs",
+                          fut.request_id, now - fut.enqueued_at)
+            if fut.sampled:
+                flight.record("request_span", request_id=fut.request_id,
+                              outcome="shed_deadline", code=504,
+                              abandoned=not owns,
+                              phases={"queue": now - fut.enqueued_at})
+            return
+        try:
+            fault_point("infer")
+            slot, first = self.session.admit(fut.x, fut.max_new_tokens)
+        except Exception as e:
+            log.warning("prefill failed for request %s: %s: %s",
+                        fut.request_id, type(e).__name__, e)
+            fut._resolve(error=e)
+            if active and getattr(e, "all_sequences_lost", False):
+                # the session's KV cache was lost mid-prefill (duck-typed
+                # marker, see transformer.KvCacheLostError): every rider's
+                # sequence died with it — fail them now rather than let the
+                # next decode step hand them tokens from a zeroed cache
+                log.warning("KV cache lost: failing %d in-flight "
+                            "generations", len(active))
+                for rider in active.values():
+                    self._md.evicted.labels(reason="cache_lost").inc()
+                    self._evicted += 1
+                    rider._resolve(error=e)
+                    self._record_abandoned_span(rider)
+                active.clear()
+                self._md.slot_occupancy.set(0)
+            return
+        prefill_s = time.monotonic() - now
+        self._md.admitted.inc()
+        self._admitted += 1
+        fut.tokens.append(int(first))
+        self._md.tokens.inc()
+        self._tokens_out += 1
+        if fut.sampled:
+            fut.span = {"queue": now - fut.enqueued_at,
+                        "prefill": prefill_s, "decode": 0.0,
+                        "steps": 0, "step_ms": []}
+        if (fut.max_new_tokens == 1
+                or (self.eos_id is not None and first == self.eos_id)):
+            self.session.release(slot)  # done at prefill: slot never held
+            self._finish(fut)
+        else:
+            active[slot] = fut
+
+    def _decode_step(self, active: Dict[int, GenerationFuture]) -> None:
+        t0 = time.monotonic()
+        try:
+            fault_point("infer")
+            out = self.session.step()
+        except Exception as e:  # decode failure → every live rider sees it
+            log.warning("decode step failed for requests [%s]: %s: %s",
+                        ", ".join(str(f.request_id) for f in active.values()),
+                        type(e).__name__, e)
+            reason = ("cache_lost" if getattr(e, "all_sequences_lost", False)
+                      else "step_error")
+            for slot, fut in list(active.items()):
+                try:
+                    self.session.release(slot)
+                except Exception:
+                    log.debug("slot %d release failed after step error", slot)
+                self._md.evicted.labels(reason=reason).inc()
+                self._evicted += 1
+                fut._resolve(error=e)
+                self._record_abandoned_span(fut)
+            active.clear()
+            self._md.slot_occupancy.set(0)
+            return
+        dt = time.monotonic() - t0
+        self._md.steps.inc()
+        self._md.tokens.inc(len(out))
+        self._md.slot_occupancy.set(len(active))
+        self._steps += 1
+        self._occupancy_sum += len(active)
+        self._tokens_out += len(out)
+        now = time.monotonic()
+        for slot in list(active):
+            fut = active[slot]
+            tok = out[slot]
+            fut.tokens.append(tok)
+            fut.steps += 1
+            if fut.sampled and fut.span is not None:
+                fut.span["decode"] += dt
+                fut.span["steps"] = fut.steps
+                if len(fut.span["step_ms"]) < _SPAN_STEP_CAP:
+                    fut.span["step_ms"].append(round(dt * 1e3, 3))
+            done = (len(fut.tokens) >= fut.max_new_tokens
+                    or (self.eos_id is not None and tok == self.eos_id))
+            if done:
+                self.session.release(slot)
+                del active[slot]
+                self._finish(fut)
+            elif fut.deadline is not None and now >= fut.deadline:
+                # mid-decode deadline: EVICT at the step boundary — the
+                # slot frees for a queued request this very iteration, and
+                # the waiter's existing 504 path answers the client
+                self.session.release(slot)
+                del active[slot]
+                self._md.evicted.labels(reason="deadline").inc()
+                self._evicted += 1
+                owns = fut._expire(DeadlineExceededError(
+                    f"deadline expired mid-decode after {fut.steps} steps "
+                    f"({len(fut.tokens)}/{fut.max_new_tokens} tokens)"))
+                if owns:
+                    self._m.shed.labels(reason="decode_deadline").inc()
+                    log.debug("request %s: evicted mid-decode after %d steps",
+                              fut.request_id, fut.steps)
+                if fut.sampled:
+                    phases = self._span_phases(fut)
+                    flight.record("request_span", request_id=fut.request_id,
+                                  outcome="shed_deadline", code=504,
+                                  abandoned=not owns, **phases)
+        self._md.slot_occupancy.set(len(active))
+
+    def _finish(self, fut: GenerationFuture) -> None:
+        fut._resolve(result=np.asarray(fut.tokens, np.int32))
+        self._record_abandoned_span(fut)
+
+    @staticmethod
+    def _span_phases(fut: GenerationFuture) -> dict:
+        span = dict(fut.span or {})
+        extra = {k: span.pop(k) for k in SPAN_EXTRA_KEYS if k in span}
+        return {"phases": span, **extra}
+
+    @staticmethod
+    def _record_abandoned_span(fut) -> None:
+        """Generative twin of the base class hook: an abandoned (waiter
+        504'd) sampled request still leaves its decode timeline."""
+        with fut._lock:
+            abandoned = fut.abandoned
+        if abandoned and fut.sampled:
+            flight.record("request_span", request_id=fut.request_id,
+                          outcome="shed_deadline", code=504, abandoned=True,
+                          **GenerativeInferenceExecutor._span_phases(fut))
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """This executor's continuous-batching aggregates (bench evidence):
+        decode steps, emitted tokens, admissions/evictions, and MEAN slot
+        occupancy per step — the measured batching-efficiency number the
+        continuous-vs-static comparison reports."""
+        return {
+            "steps": self._steps,
+            "tokens": self._tokens_out,
+            "admitted": self._admitted,
+            "evicted": self._evicted,
+            "mean_slot_occupancy": (round(self._occupancy_sum / self._steps, 3)
+                                    if self._steps else 0.0),
+        }

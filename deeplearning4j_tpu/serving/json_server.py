@@ -42,8 +42,9 @@ import numpy as np
 
 from ..monitoring import flight
 from ..monitoring.serving import client_metrics, serving_metrics
-from .executor import (BatchingInferenceExecutor, DeadlineExceededError,
-                       ExecutorClosedError, QueueFullError)
+from .executor import (SPAN_EXTRA_KEYS, BatchingInferenceExecutor,
+                       DeadlineExceededError, ExecutorClosedError,
+                       QueueFullError)
 
 log = logging.getLogger(__name__)
 
@@ -83,7 +84,8 @@ class JsonModelServer:
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
                  warmup_input=None, registry=None, span_sample_n: int = 1,
                  compile_cache_dir: Optional[str] = None,
-                 warmup_all_buckets: Optional[bool] = None):
+                 warmup_all_buckets: Optional[bool] = None,
+                 generative_session=None, default_max_new_tokens: int = 32):
         # ISSUE 12: an explicit cache dir wins; else the TDL_COMPILE_CACHE_DIR
         # env contract — enabled before any warmup compile so a warming
         # replica restores executables from disk
@@ -95,7 +97,20 @@ class JsonModelServer:
             compile_cache.maybe_enable_from_env()
         self.warmup_all_buckets = warmup_all_buckets
         self.model = model
-        self.deserializer = deserializer or (lambda d: np.asarray(d, np.float32))
+        #: ISSUE 13: a decode slot pool (``models.transformer.DecodeSlotPool``
+        #: or duck-equivalent) flips the server into GENERATIVE mode — the
+        #: executor underneath becomes a continuous-batching decode loop and
+        #: payloads are token sequences, not feature rows
+        self.generative_session = generative_session
+        self.default_max_new_tokens = default_max_new_tokens
+        if deserializer is None:
+            # generative payloads keep their JSON dtype: casting to int32
+            # here would silently truncate float token ids before the
+            # executor's integer validation (its 400) could reject them
+            deserializer = ((lambda d: np.asarray(d))
+                            if generative_session is not None
+                            else (lambda d: np.asarray(d, np.float32)))
+        self.deserializer = deserializer
         self.serializer = serializer or (lambda a: np.asarray(a).tolist())
         self.endpoint = endpoint
         self.parallel_inference = parallel_inference
@@ -161,6 +176,21 @@ class JsonModelServer:
 
         def warmup_input(self, x):
             self._kw["warmup_input"] = x
+            return self
+
+        def generative(self, session):
+            """Serve autoregressive GENERATION (ISSUE 13): ``session`` is a
+            decode slot pool (``models.transformer.DecodeSlotPool`` or
+            duck-equivalent) and the executor underneath becomes the
+            continuous-batching decode loop. Payloads are 1-D token
+            sequences; responses carry the generated token ids; the
+            ``X-Max-New-Tokens`` header bounds one request's budget."""
+            self._kw["generative_session"] = session
+            return self
+
+        def max_new_tokens(self, n: int):
+            """Default per-request generation budget (generative mode)."""
+            self._kw["default_max_new_tokens"] = n
             return self
 
         def compile_cache_dir(self, path: str):
@@ -277,6 +307,18 @@ class JsonModelServer:
                     raise ValueError
             except ValueError:
                 return 400, {"error": f"bad X-Deadline-Ms {header!r}"}, None
+        submit_kw = {}
+        if self.generative_session is not None:
+            # per-request token budget (generative mode): the header bounds
+            # this request's decode steps; absent → the server default
+            mnt = handler.headers.get("X-Max-New-Tokens")
+            if mnt is not None:
+                try:
+                    submit_kw["max_new_tokens"] = int(mnt)
+                    if submit_kw["max_new_tokens"] <= 0:
+                        raise ValueError
+                except ValueError:
+                    return 400, {"error": f"bad X-Max-New-Tokens {mnt!r}"}, None
         # 400 = the CALLER's fault (malformed JSON / undecodable payload);
         # clients retry 5xx against a replica but must not retry a bad payload
         try:
@@ -284,7 +326,8 @@ class JsonModelServer:
         except Exception as e:
             return 400, {"error": f"{type(e).__name__}: {e}"}, None
         try:
-            fut = executor.submit(x, deadline_ms=deadline_ms, request_id=rid)
+            fut = executor.submit(x, deadline_ms=deadline_ms, request_id=rid,
+                                  **submit_kw)
         except QueueFullError as e:
             return 429, {"error": str(e)}, RETRY_AFTER_S
         except ExecutorClosedError as e:
@@ -336,11 +379,13 @@ class JsonModelServer:
         if not fut.sampled:
             return
         phases = dict(fut.span or {})
-        rows = phases.pop("batch_rows", None)
+        # non-phase span payload: micro-batch rows, and (generative mode,
+        # ISSUE 13) the per-step decode timeline + step count
+        extra = {k: phases.pop(k) for k in SPAN_EXTRA_KEYS if k in phases}
         if serialize is not None:
             phases["serialize"] = serialize
         flight.record("request_span", request_id=rid, outcome=outcome,
-                      code=code, phases=phases, batch_rows=rows)
+                      code=code, phases=phases, **extra)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -348,18 +393,28 @@ class JsonModelServer:
         if self._httpd is not None:
             return self
         self._shutting_down = False
-        pi = self.parallel_inference
-        if pi is None and self.batch_limit is not None:
-            from ..parallel.inference import ParallelInference
-            pi = ParallelInference(self.model, batch_limit=self.batch_limit)
-            self.parallel_inference = pi
-        self._executor = BatchingInferenceExecutor(
-            model=self.model, parallel_inference=pi,
-            max_queue=self.max_queue, max_batch_rows=self.max_batch_rows,
-            default_deadline_ms=self.default_deadline_ms,
-            warmup_input=self.warmup_input, registry=self.registry,
-            span_sample_n=self.span_sample_n,
-            warmup_all_buckets=self.warmup_all_buckets).start()
+        if self.generative_session is not None:
+            from .executor import GenerativeInferenceExecutor
+
+            self._executor = GenerativeInferenceExecutor(
+                self.generative_session, max_queue=self.max_queue,
+                default_max_new_tokens=self.default_max_new_tokens,
+                default_deadline_ms=self.default_deadline_ms,
+                warmup_prompt=self.warmup_input, registry=self.registry,
+                span_sample_n=self.span_sample_n).start()
+        else:
+            pi = self.parallel_inference
+            if pi is None and self.batch_limit is not None:
+                from ..parallel.inference import ParallelInference
+                pi = ParallelInference(self.model, batch_limit=self.batch_limit)
+                self.parallel_inference = pi
+            self._executor = BatchingInferenceExecutor(
+                model=self.model, parallel_inference=pi,
+                max_queue=self.max_queue, max_batch_rows=self.max_batch_rows,
+                default_deadline_ms=self.default_deadline_ms,
+                warmup_input=self.warmup_input, registry=self.registry,
+                span_sample_n=self.span_sample_n,
+                warmup_all_buckets=self.warmup_all_buckets).start()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -569,6 +624,7 @@ class JsonModelClient:
         try:
             for attempt in range(self.retries + 1):
                 retry_after = None
+                count_failure = True
                 req = urllib.request.Request(self.url, data=body,
                                              headers=headers)
                 try:
@@ -598,6 +654,15 @@ class JsonModelClient:
                     retry_reason = f"http_{e.code}"
                     retry_after = (e.headers.get("Retry-After")
                                    if e.headers else None)
+                    if e.code == 503 and "pool not ready" in (detail or ""):
+                        # a router 503 during a rolling restart is the
+                        # pool's 429 (ISSUE 13 satellite): back off per its
+                        # Retry-After, count the retry under its own label,
+                        # and NEVER let a single not-ready probe march the
+                        # circuit breaker toward open — replicas restarting
+                        # is normal operation, not a failing endpoint
+                        retry_reason = "pool_unready"
+                        count_failure = False
                 except urllib.error.URLError as e:
                     last_msg = f"cannot reach {self.url}: {e.reason}"
                     outcome = "connection"
@@ -612,7 +677,8 @@ class JsonModelClient:
                                 f"{type(e).__name__}: {e}")
                     outcome = "connection"
                     retry_reason = "connection"
-                self._record_failure()
+                if count_failure:
+                    self._record_failure()
                 if attempt >= self.retries:
                     break
                 with self._breaker_lock:
