@@ -173,6 +173,8 @@ def _scale(on_tpu):
                                  queue=256, replicas=2,
                                  pool_duration_s=8.0, pool_rate=30.0,
                                  slo_threshold_ms=1000.0, slo_target=0.99),
+            "reshard": dict(features=64, hidden=512, classes=8, steps=4,
+                            replicas=2),
             "compile_cache": dict(features=64, classes=8, batch_limit=16,
                                   max_rows=128, fit_batch=128, fit_steps=4,
                                   flash=dict(B=1, H=12, T=8192, D=64,
@@ -199,6 +201,8 @@ def _scale(on_tpu):
                              vocab=256, max_len=64, queue=128, replicas=2,
                              pool_duration_s=4.0, pool_rate=12.0,
                              slo_threshold_ms=2000.0, slo_target=0.95),
+        "reshard": dict(features=16, hidden=32, classes=4, steps=2,
+                        replicas=2),
         "compile_cache": dict(features=16, classes=4, batch_limit=8,
                               max_rows=32, fit_batch=32, fit_steps=2,
                               flash=dict(B=1, H=2, T=128, D=16, trials=1)),
@@ -1365,6 +1369,197 @@ def _baseline_ratio(backend, value, config):
     return 1.0
 
 
+# ------------------------------------------------------------------- reshard
+
+
+def _chunked_ckpt_write(ckdir, state, fsdp, n_files, iteration=1):
+    """Write a checkpoint in TrainingCheckpointer's on-disk format AS IF an
+    ``fsdp=<fsdp>`` gang of ``n_files`` processes had saved it: each leaf is
+    tiled into fsdp contiguous dim-0 chunks (where divisible) and the chunks
+    are distributed round-robin over the shard files. Lets the bench measure
+    a 4-rank-source restore on whatever devices this process actually has."""
+    # the REAL path-syntax walker: a local copy would silently drift from
+    # the on-disk format the restore actually reads
+    from deeplearning4j_tpu.serde.checkpoint import _leaf_paths
+
+    os.makedirs(ckdir, exist_ok=True)
+    blobs = [{"__save_id__": np.asarray(iteration, np.int64)}
+             for _ in range(n_files)]
+    rr = 0
+    for path, leaf in _leaf_paths(state):
+        if not hasattr(leaf, "dtype"):
+            continue
+        a = np.asarray(leaf)
+        parts = fsdp if a.ndim and a.shape[0] % fsdp == 0 else 1
+        step = (a.shape[0] // parts) if a.ndim else 0
+        for si in range(parts):
+            idx = [[0, n] for n in a.shape]
+            chunk = a
+            if parts > 1:
+                idx[0] = [si * step, (si + 1) * step]
+                chunk = a[si * step:(si + 1) * step]
+            blob = blobs[rr % n_files]
+            rr += 1
+            key = f"{path}|{si}"
+            blob[key] = chunk
+            blob[f"{key}|idx"] = np.asarray(idx, np.int64)
+            blob[f"{key}|shape"] = np.asarray(list(a.shape), np.int64)
+    for proc, blob in enumerate(blobs):
+        with open(os.path.join(ckdir, f"shard_{proc}.npz"), "wb") as f:
+            np.savez(f, **blob)
+    meta = {"iteration": iteration, "epoch": 0, "score": None,
+            "process_count": n_files,
+            "mesh_layout": {"axes": {"data": 1, "fsdp": fsdp, "tp": 1},
+                            "axis_names": ["data", "fsdp", "tp"]}}
+    with open(os.path.join(ckdir, "train_state.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def _swap_replica():
+    """Replica target (``bench:_swap_replica``) for the reshard bench's
+    swap-window phase: a small real MLN restored from the TDL_MODEL_CKPT
+    checkpoint dir, warmed from the pool's shared persistent compile cache —
+    the configuration swap_model prices in production."""
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serde.checkpoint import TrainingCheckpointer
+    from deeplearning4j_tpu.serving import JsonModelServer
+
+    p = json.loads(os.environ["TDL_BENCH_SWAP_CFG"])
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3)).list()
+            .layer(DenseLayer(n_in=p["features"], n_out=p["hidden"],
+                              activation="relu"))
+            .layer(OutputLayer(n_out=p["classes"], activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ckpt = os.environ.get("TDL_MODEL_CKPT")
+    if ckpt:
+        TrainingCheckpointer(ckpt, async_write=False).restore(net)
+    return JsonModelServer(
+        net, port=0, max_queue=64,
+        warmup_input=np.zeros((1, p["features"]), np.float32))
+
+
+def bench_reshard(p):
+    """ISSUE 14: the cost of elasticity as tracked numbers.
+
+    Phase 1 — the restore matrix: a 4-rank fsdp=4 checkpoint (written in the
+    real on-disk format by :func:`_chunked_ckpt_write`) restored onto target
+    layouts emulating 4, 2, and 8 ranks (clamped to the devices this process
+    has; each row reports what actually ran and whether the saved and target
+    layouts matched — a mismatch is a true cross-topology reshard through
+    the chunk-intersection path, feeding ``tdl_reshard_*``).
+
+    Phase 2 — the swap window: a 2-replica ServingPool of real MLN replicas
+    rolls to a new checkpoint via ``swap_model`` with the persistent compile
+    cache warm (the initial spawns populated it), so the reported window is
+    restore + deserialization, not XLA compilation."""
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.mesh import mesh_from_shape
+    from deeplearning4j_tpu.parallel.partition import (Partitioner,
+                                                       largest_layout)
+    from deeplearning4j_tpu.serde.checkpoint import TrainingCheckpointer
+    from deeplearning4j_tpu.serving import ServingPool
+
+    def build_net():
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=p["features"], n_out=p["hidden"],
+                                  activation="relu"))
+                .layer(OutputLayer(n_out=p["classes"], activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, p["features"]).astype(np.float32)
+    Y = np.eye(p["classes"], dtype=np.float32)[
+        rs.randint(0, p["classes"], 32)]
+    src = build_net()
+    for _ in range(p["steps"]):
+        src._fit_batch(DataSet(X, Y))
+    state = {"params": src.params_, "updater": src.updater_state,
+             "bn": src.bn_state}
+    host = {k: jax.tree.map(lambda a: np.asarray(a), v)
+            for k, v in state.items()}
+    state_bytes = sum(a.nbytes for a in jax.tree.leaves(host))
+
+    n_dev = len(jax.devices())
+    out = {"metric": "reshard_restore_ms", "unit": "ms",
+           "source": {"ranks": 4, "layout_fsdp": 4,
+                      "state_bytes": state_bytes},
+           "devices": n_dev, "restore": {}}
+    with tempfile.TemporaryDirectory() as d:
+        ckdir = os.path.join(d, "ck", "latest")
+        _chunked_ckpt_write(ckdir, host, fsdp=4, n_files=4,
+                            iteration=int(src.iteration))
+        for name, want in (("4_to_4", 4), ("4_to_2", 2), ("4_to_8", 8)):
+            tdev = min(want, n_dev)
+            layout = largest_layout(tdev)
+            part = Partitioner(layout, mesh=mesh_from_shape(
+                layout.shape(), devices=jax.devices()[:tdev]))
+            fresh = build_net()
+            ck = TrainingCheckpointer(os.path.join(d, "ck"),
+                                      partitioner=part, reshard=True)
+            t0 = time.perf_counter()
+            assert ck.restore(fresh)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            exact = all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(jax.tree.leaves(host["params"]),
+                                jax.tree.leaves(fresh.params_)))
+            out["restore"][name] = {
+                "target_devices": tdev,
+                "target_layout": part.describe()["axes"],
+                "same_layout": part.describe() == {
+                    "axes": {"data": 1, "fsdp": 4, "tp": 1},
+                    "axis_names": ["data", "fsdp", "tp"]},
+                "restore_ms": round(wall_ms, 2),
+                "exact": bool(exact),
+            }
+        out["value"] = out["restore"]["4_to_2"]["restore_ms"]
+
+        # ---- phase 2: the swap window over a live pool ------------------
+        v1, v2 = os.path.join(d, "m1"), os.path.join(d, "m2")
+        TrainingCheckpointer(v1, async_write=False).save(src)
+        src._fit_batch(DataSet(X, Y))  # v2 is a genuinely different model
+        TrainingCheckpointer(v2, async_write=False).save(src)
+        pool = ServingPool(
+            "bench:_swap_replica", replicas=p["replicas"], min_replicas=1,
+            max_replicas=p["replicas"] + 1,
+            workdir=os.path.join(d, "pool"),
+            extra_env={"TDL_BENCH_SWAP_CFG": json.dumps(p),
+                       "TDL_MODEL_CKPT": v1})
+        swap = {"replicas": p["replicas"]}
+        try:
+            pool.start()
+            if not pool.wait_ready(300.0):
+                swap["error"] = "pool never became ready"
+            else:
+                res = pool.swap_model(v2)
+                swap.update({
+                    # the headline: full rolling swap, compile cache warm
+                    "swap_window_s": res["window_s"],
+                    "swapped": res["swapped"],
+                    "rolled_back": res["rolled_back"],
+                    "per_replica_s": round(
+                        res["window_s"] / max(1, res["swapped"]), 3),
+                })
+        finally:
+            pool.stop()
+        out["swap"] = swap
+    return out
+
+
 # ------------------------------------------------------- compile cache
 
 
@@ -1515,6 +1710,7 @@ BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "w2v": bench_w2v, "bert": bench_bert, "serving": bench_serving,
            "serving_slo": bench_serving_slo, "bert_large_fsdp": bench_fsdp,
            "serving_pool": bench_serving_pool,
+           "reshard": bench_reshard,
            "compile_cache": bench_compile_cache}
 
 

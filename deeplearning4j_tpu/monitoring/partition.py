@@ -14,6 +14,17 @@ Families::
                                         ~linearly with the fsdp axis)
     tdl_mesh_layout_info{data,fsdp,tp}  one series describing the active mesh
                                         layout; value = devices in the mesh
+
+Elasticity families (ISSUE 14 — the cross-topology restore and the gang
+resize it enables)::
+
+    tdl_reshard_bytes_total             bytes copied into this process's
+                                        addressable shards by reshard=True
+                                        cross-topology checkpoint restores
+    tdl_reshard_seconds                 wall time of one cross-topology
+                                        restore (per restore() call)
+    tdl_gang_resizes_total{direction}   GangSupervisor elastic resizes to the
+                                        surviving healthy ranks
 """
 
 from __future__ import annotations
@@ -36,4 +47,23 @@ def partition_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNames
             "tdl_mesh_layout_info",
             "active data/fsdp/tp mesh layout; value = mesh device count",
             labels=("data", "fsdp", "tp")),
+    )
+
+
+def elastic_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespace:
+    """Get-or-create the elasticity families (ISSUE 14): the cost of a
+    cross-topology restore and the gang resizes that consume it."""
+    r = registry if registry is not None else get_registry()
+    return SimpleNamespace(
+        reshard_bytes=r.counter(
+            "tdl_reshard_bytes_total",
+            "bytes copied into this process's addressable shards by "
+            "cross-topology (reshard=True) checkpoint restores"),
+        reshard_seconds=r.histogram(
+            "tdl_reshard_seconds",
+            "wall seconds of one cross-topology checkpoint restore"),
+        gang_resizes=r.counter(
+            "tdl_gang_resizes_total",
+            "elastic gang resizes to the surviving healthy ranks, by "
+            "direction", labels=("direction",)),
     )

@@ -41,9 +41,14 @@ through the PR 7 spool merge)::
     tdl_pool_size                           live replica processes (gauge)
     tdl_pool_replica_state{replica,state}   1 for the replica's current
                                             state (starting/ready/unready/
-                                            dead), 0 otherwise
+                                            draining/dead), 0 otherwise
     tdl_pool_scale_events_total{direction}  autoscaler/manual resizes (up,
                                             down)
+    tdl_pool_swap_events_total              completed zero-downtime model
+                                            swaps (ISSUE 14)
+    tdl_pool_swap_rollbacks_total           swaps aborted because the new
+                                            model failed validation (the old
+                                            version kept serving)
 """
 
 from __future__ import annotations
@@ -119,11 +124,20 @@ def pool_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespace:
         replica_state=r.gauge(
             "tdl_pool_replica_state",
             "1 for the replica's current state, 0 for its other states "
-            "(starting/ready/unready/dead)", labels=("replica", "state")),
+            "(starting/ready/unready/draining/dead)",
+            labels=("replica", "state")),
         scale_events=r.counter(
             "tdl_pool_scale_events_total",
             "replica-pool resizes by direction (autoscaler or manual)",
             labels=("direction",)),
+        swap_events=r.counter(
+            "tdl_pool_swap_events_total",
+            "zero-downtime model swaps completed (every replica rolled to "
+            "the new checkpoint)"),
+        swap_rollbacks=r.counter(
+            "tdl_pool_swap_rollbacks_total",
+            "model swaps rolled back because the new model failed to become "
+            "ready (the old version kept serving)"),
     )
 
 

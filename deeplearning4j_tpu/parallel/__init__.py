@@ -1,5 +1,6 @@
 from .mesh import MeshSpec, build_mesh, device_count, mesh_from_shape
-from .partition import Partitioner, PartitionReport, SpecLayout, param_role_tree
+from .partition import (Partitioner, PartitionReport, SpecLayout,
+                        largest_layout, param_role_tree)
 from .sharding import ShardingRules, DP, TP_COLUMN, TP_ROW, replicated, shard_batch, shard_params
 from .trainer import (
     MultiProcessTrainer,
@@ -20,6 +21,7 @@ __all__ = [
     "Partitioner",
     "PartitionReport",
     "SpecLayout",
+    "largest_layout",
     "param_role_tree",
     "ShardingRules",
     "DP",

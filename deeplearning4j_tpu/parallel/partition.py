@@ -125,6 +125,22 @@ class SpecLayout:
                 "axis_names": [self.data_axis, self.fsdp_axis, self.tp_axis]}
 
 
+def largest_layout(n_devices: int, tp: int = 1, data: int = 1) -> SpecLayout:
+    """The largest valid :class:`SpecLayout` for a device count (ISSUE 14 —
+    what an elastically-resized gang builds for its survivor count): ``fsdp``
+    absorbs every device not claimed by ``data``/``tp``; a requested
+    ``data``/``tp`` that does not divide falls back to its largest feasible
+    divisor, never an invalid mesh."""
+    n = max(1, int(n_devices))
+    data = max(1, int(data))
+    while n % data:
+        data -= 1
+    tp = max(1, min(int(tp), n // data))
+    while (n // data) % tp:
+        tp -= 1
+    return SpecLayout(data=data, fsdp=n // (data * tp), tp=tp)
+
+
 # ------------------------------------------------------------------ role trees
 
 
@@ -290,7 +306,11 @@ class Partitioner:
         sharding = self.sharding_for(spec)
         if isinstance(leaf, jax.Array) and leaf.sharding == sharding:
             return leaf  # already placed (e.g. a sharded checkpoint restore)
-        host = np.asarray(leaf)
+        # the input here is a host array or a replicated leaf (the
+        # replicated→sharded upgrade path) — a DIFFERENTLY-sharded source
+        # never routes through placement; it restores via the chunk-
+        # intersection path in serde.checkpoint instead
+        host = np.asarray(leaf)  # gather-ok: host/replicated input only
         # each process materializes only its addressable shards — works
         # identically on a single-process mesh and across a gang (where
         # jax.device_put cannot address non-local devices)

@@ -20,7 +20,19 @@ gang restart from checkpoint:
 - failures are classified: ``crash`` (nonzero exit), ``hang`` (stalled
   heartbeat), ``bind`` (coordinator port race — retried on its own budget),
   and repeated crash at the same iteration ⇒ fatal (restarting cannot help a
-  deterministic fault; surface it instead of looping).
+  deterministic fault; surface it instead of looping);
+- ``elastic=True`` (ISSUE 14): when the restart budget at the current size
+  is exhausted and the SAME rank(s) were implicated every time — the
+  permanently-dead-host signature, a rank that cannot even boot — the
+  supervisor degrades to the surviving healthy ranks instead of classifying
+  fatal: it respawns the gang at size ``n - |suspects|`` (never below
+  ``min_processes``), the workers build the largest valid ``SpecLayout`` for
+  the survivor count and restore the bigger gang's checkpoint through the
+  cross-topology ``reshard=True`` path, and the resize is recorded as a
+  ``gang_resize`` flight event, ``tdl_gang_resizes_total{direction}``, and a
+  ``resizes`` section in ``postmortem.json``. Repeated crash at the same
+  ITERATION stays fatal — that is a deterministic software fault, not a
+  dead host, and shrinking the gang cannot fix it.
 
 Recovery is observable through the PR-1 metrics registry:
 ``tdl_worker_deaths_total{reason}``, ``tdl_gang_restarts_total`` and the
@@ -198,6 +210,8 @@ class GangSupervisor:
         port_retries: int = 3,
         kill_grace: float = 5.0,
         same_iteration_fatal: int = 3,
+        elastic: bool = False,
+        min_processes: int = 1,
         registry: Optional[MetricsRegistry] = None,
     ):
         self.target = target
@@ -228,9 +242,14 @@ class GangSupervisor:
         self.port_retries = port_retries
         self.kill_grace = kill_grace
         self.same_iteration_fatal = max(2, same_iteration_fatal)
+        self.elastic = elastic
+        self.min_processes = max(1, min_processes)
         self.registry = registry or get_registry()
         (self._deaths, self._restarts_ctr, self._recovery_hist,
          self._last_failure_info) = _supervisor_metrics(self.registry)
+        from ..monitoring.partition import elastic_metrics
+
+        self._resizes_ctr = elastic_metrics(self.registry).gang_resizes
         # the supervisor's own black box (restart decisions, classifications);
         # ring-only — its events merge into postmortem.json from memory
         self._flight = FlightRecorder(proc="supervisor")
@@ -247,8 +266,17 @@ class GangSupervisor:
         self.compile_cache_dir = os.path.join(self.workdir, "compile_cache")
 
         self.events: List[GangEvent] = []
-        self.restarts = 0           # budgeted restarts performed
+        self.restarts = 0           # budgeted restarts performed (total)
         self.port_failures = 0      # bind-race respawns (separate budget)
+        #: restarts burned at the CURRENT gang size — an elastic resize
+        #: grants the smaller gang a fresh budget
+        self._restarts_this_size = 0
+        #: elastic resizes performed, newest last (mirrored into postmortems)
+        self.resizes: List[Dict] = []
+        #: index into ``events`` where the current gang size began — resize
+        #: suspect analysis must never read events from a BIGGER gang whose
+        #: rank ids no longer mean the same thing
+        self._events_mark = 0
         # crash iterations only: which rank died can vary run-to-run (the
         # injected rank vs a sibling aborted by gloo noticing the dead peer),
         # but a deterministic fault replays the same ITERATION every time
@@ -290,19 +318,25 @@ class GangSupervisor:
                             f"coordinator bind failed {self.port_failures} times",
                             "bind", self.events)
                 else:
-                    if self.restarts >= self.max_restarts:
-                        raise GangFailedError(
-                            f"gang failed ({failure.reason} at iteration "
-                            f"{failure.iteration}, ranks {failure.ranks}) and the "
-                            f"restart budget ({self.max_restarts}) is exhausted",
-                            self._final_classification(failure), self.events)
-                    self.restarts += 1
-                    self._restarts_ctr.inc()
-                    self._flight.record(
-                        "restart_decision", decision="restart",
-                        reason=failure.reason, ranks=list(failure.ranks),
-                        iteration=failure.iteration, restart=self.restarts)
-                    self._backoff(self.restarts)
+                    if self._restarts_this_size >= self.max_restarts:
+                        # last resort before fatal: degrade to the surviving
+                        # healthy ranks (ISSUE 14) — only when elastic, only
+                        # when the failures consistently name the same ranks
+                        if not self._try_resize(failure):
+                            raise GangFailedError(
+                                f"gang failed ({failure.reason} at iteration "
+                                f"{failure.iteration}, ranks {failure.ranks}) and the "
+                                f"restart budget ({self.max_restarts}) is exhausted",
+                                self._final_classification(failure), self.events)
+                    else:
+                        self.restarts += 1
+                        self._restarts_this_size += 1
+                        self._restarts_ctr.inc()
+                        self._flight.record(
+                            "restart_decision", decision="restart",
+                            reason=failure.reason, ranks=list(failure.ranks),
+                            iteration=failure.iteration, restart=self.restarts)
+                        self._backoff(self._restarts_this_size)
             except GangFailedError as e:
                 self._flight.record(
                     "restart_decision", decision="fatal",
@@ -535,6 +569,12 @@ class GangSupervisor:
             # alert INTERVALS (ISSUE 11): paired alert/alert_clear edges —
             # what was firing (and for how long) around the failure
             "alert_intervals": _alert_intervals(events),
+            # elastic resizes performed so far (ISSUE 14): how the gang got
+            # to its current size — "we lost rank 1's host at iteration 3
+            # and have been running 1-wide since" is postmortem headline
+            # material, not something to reverse-engineer from the timeline
+            "resizes": list(self.resizes),
+            "gang_size": self.n_processes,
             "events": events,
         }
         tmp = self.postmortem_path + ".tmp"
@@ -580,6 +620,64 @@ class GangSupervisor:
                 f"rank(s) {failure.ranks} crashed {repeats}x at iteration "
                 f"{failure.iteration} — deterministic fault, not restarting",
                 "repeated_crash_same_iteration", self.events)
+
+    def _try_resize(self, failure: GangEvent) -> bool:
+        """Elastic degrade (ISSUE 14): called when the restart budget at the
+        current size is exhausted. Returns True when the gang was resized to
+        the surviving healthy ranks (the run loop then respawns at the new
+        size with a fresh budget); False means fatal is the right call.
+
+        The culprit set is the INTERSECTION of the implicated ranks across
+        the budget-exhausting failures at this size — a permanently dead
+        host names itself every time; a wandering failure (different ranks
+        each attempt) is a software fault resizing can't fix."""
+        if not self.elastic or failure.reason not in ("crash", "hang"):
+            return False
+        # only crash/hang failures AT THIS SIZE vote: a bind race rides its
+        # own budget (and implicates rank 0 by construction), and events
+        # from before a previous resize carry renumbered rank ids — either
+        # would poison the intersection and block a legitimate resize
+        recent = [e for e in self.events[self._events_mark:]
+                  if e.reason in ("crash", "hang")][-(self.max_restarts + 1):]
+        suspects = set(failure.ranks)
+        for e in recent:
+            suspects &= set(e.ranks)
+        if not suspects:
+            return False
+        new_n = self.n_processes - len(suspects)
+        if new_n < self.min_processes or new_n >= self.n_processes:
+            return False
+        from .partition import largest_layout
+
+        layout = largest_layout(new_n * self.n_local_devices)
+        entry = {
+            "direction": "down",
+            "from_processes": self.n_processes,
+            "to_processes": new_n,
+            "suspect_ranks": sorted(suspects),
+            "reason": failure.reason,
+            "iteration": failure.iteration,
+            "restarts_spent": self.restarts,
+            "survivor_layout": layout.describe(),
+        }
+        self.resizes.append(entry)
+        self._resizes_ctr.labels("down").inc()
+        self._flight.record("gang_resize", **entry)
+        log.warning(
+            "elastic resize: gang degrades %d -> %d processes (ranks %s "
+            "kept failing; survivors restore cross-topology and continue)",
+            self.n_processes, new_n, sorted(suspects))
+        self.n_processes = new_n
+        # fresh budget + fresh crash history: the smaller gang is a new
+        # context — but a deterministic same-iteration crash will re-classify
+        # itself fatal there just as it would have here
+        self._restarts_this_size = 0
+        self._crash_history.clear()
+        self._events_mark = len(self.events)
+        # re-write the postmortem NOW so the on-disk record carries the
+        # resize (the per-failure write above ran before the decision)
+        self._write_postmortem(failure, classification="elastic_resize")
+        return True
 
     def _final_classification(self, failure: GangEvent) -> str:
         if (failure.reason == "crash" and failure.iteration is not None

@@ -75,6 +75,19 @@ def _set_leaf(tree, path: str, value):
     return rec(tree, 0)
 
 
+def _get_leaf(tree, path: str):
+    """Fetch a leaf by ``_leaf_paths`` path syntax (``a/0#/W``); None when
+    the path does not resolve (model drift)."""
+    cur = tree
+    for p in path.split("/"):
+        key = int(p[:-1]) if p.endswith("#") else p
+        try:
+            cur = cur[key]
+        except (KeyError, IndexError, TypeError):
+            return None
+    return cur
+
+
 def _gather_local_shards(state_tree) -> Dict[str, Any]:
     """{leaf_path: [(index_slices, np_data), ...]} for this process."""
     out: Dict[str, Any] = {}
@@ -136,13 +149,20 @@ def _spec_paths(tree, prefix=""):
         yield prefix[:-1], PartitionSpec()
 
 
-def _fill_from_chunks(index, chunks, shape, path):
+def _fill_from_chunks(index, chunks, shape, path, stats=None):
     """One addressable shard's data, copied from the overlapping saved
     chunks. ``index`` is the target shard's global slice tuple; each chunk is
     ``(saved_idx [[start,stop]...], saved_shape, npz, key)``. Only
-    overlapping chunks are decompressed."""
+    overlapping chunks are decompressed — this is the source→target chunk
+    INTERSECTION of arXiv:2112.01075, and it is layout-agnostic: the saved
+    chunks need not line up with the target shard boundaries (the
+    cross-topology reshard=True path), they only need to tile the leaf.
+    Coverage is verified cell-for-cell: the replica-0 filter on save makes
+    the saved chunks a disjoint tiling, so copied-cells == shard-cells iff
+    every target cell was written exactly once."""
     idx = _norm_index(index, shape)
     out = None
+    copied = 0
     for saved_idx, _, npz, key in chunks:
         ov = [(max(t.start, int(lo)), min(t.stop, int(hi)))
               for t, (lo, hi) in zip(idx, saved_idx)]
@@ -156,10 +176,15 @@ def _fill_from_chunks(index, chunks, shape, path):
         src = tuple(slice(lo - int(slo), hi - int(slo))
                     for (lo, hi), (slo, _) in zip(ov, saved_idx))
         out[dst] = data[src]
-    if out is None:
+        copied += int(np.prod([hi - lo for lo, hi in ov]))
+    size = int(np.prod([t.stop - t.start for t in idx])) if idx else 1
+    if out is None or copied != size:
         raise ValueError(
-            f"no saved chunk covers shard {idx} of {path!r} — checkpoint "
-            "does not tile this leaf (torn or foreign-layout write)")
+            f"saved chunks cover {copied}/{size} cells of shard {idx} of "
+            f"{path!r} — checkpoint does not tile this leaf (torn, "
+            "overlapping, or foreign-layout write)")
+    if stats is not None:
+        stats["bytes"] += int(out.nbytes)
     return out
 
 
@@ -176,17 +201,25 @@ class TrainingCheckpointer:
       array (the Rink et al. arXiv:2112.01075 constraint); at most one saved
       shard-chunk is resident per copy,
     - restore onto a MISMATCHED layout fails with an error naming both
-      layouts (cross-layout resharding is ROADMAP item 5),
+      layouts — unless ``reshard=True`` (ISSUE 14): then the saved chunks
+      are REDISTRIBUTED onto the new layout through the same source→target
+      chunk intersection (each rank decompresses only the saved chunks
+      overlapping its addressable shards, so the no-full-array constraint
+      holds across layouts too; optimizer state reshards through the same
+      structural-mirror rule as placement). Genuinely incompatible
+      checkpoints — a param whose SHAPE changed, chunks missing or not
+      tiling a leaf — still fail loudly naming the problem,
     - a replicated (layout-less) checkpoint still restores under a
       partitioner: it assembles host-side as before and the trainer's
       ``_place_net`` re-shards it.
     """
 
     def __init__(self, directory: str, async_write: bool = True,
-                 partitioner=None):
+                 partitioner=None, reshard: bool = False):
         self.dir = directory
         self.async_write = async_write
         self.partitioner = partitioner
+        self.reshard = reshard
         self._writer: Optional[threading.Thread] = None
         # a failed async write must not vanish on the background thread: it
         # is captured here and re-raised from wait() / the next save()
@@ -250,6 +283,21 @@ class TrainingCheckpointer:
                 with open(tmp_m, "w") as f:
                     json.dump(meta, f)
                 os.replace(tmp_m, os.path.join(ckdir, _STATE_FILE))
+                # a SMALLER save over a bigger gang's tag (elastic resize,
+                # ISSUE 14) must not leave the dead ranks' stale shards
+                # behind: the next restore would glob them, fail the save-id
+                # check, and classify a healthy checkpoint as torn — the
+                # post-resize gang could never crash-recover again
+                for fname in os.listdir(ckdir):
+                    if not (fname.startswith("shard_")
+                            and fname.endswith(".npz")):
+                        continue
+                    try:
+                        stale_proc = int(fname[len("shard_"):-len(".npz")])
+                    except ValueError:
+                        continue
+                    if stale_proc >= meta["process_count"]:
+                        os.unlink(os.path.join(ckdir, fname))
             dt = time.perf_counter() - t0
             self._save_hist.observe(dt)
             flight.record("ckpt_save", tag=tag,
@@ -291,13 +339,17 @@ class TrainingCheckpointer:
 
     # --------------------------------------------------------------- restore
 
-    def restore(self, net, iterator=None, tag: str = "latest") -> bool:
+    def restore(self, net, iterator=None, tag: str = "latest",
+                reshard: Optional[bool] = None) -> bool:
         """Load a checkpoint into the net (+ counters, + iterator position).
         Returns False if no checkpoint exists. Replicated checkpoints
         reassemble global arrays host-side; layout-stamped checkpoints (see
         class docstring) restore shard-for-shard onto the partitioner's mesh
-        after the layout identities are verified equal."""
+        after the layout identities are verified equal. ``reshard`` (default:
+        the constructor flag) opts a MISMATCHED layout into cross-topology
+        chunk redistribution instead of the loud refusal."""
         self.wait()  # never read past our own in-flight async write
+        do_reshard = self.reshard if reshard is None else reshard
         ckdir = os.path.join(self.dir, tag)
         state_path = os.path.join(ckdir, _STATE_FILE)
         if not os.path.exists(state_path):
@@ -306,13 +358,15 @@ class TrainingCheckpointer:
             meta = json.load(f)
         saved_layout = meta.get("mesh_layout")
         want = self.partitioner.describe() if self.partitioner is not None else None
-        if saved_layout is not None and saved_layout != want:
+        resharding = saved_layout is not None and saved_layout != want
+        if resharding and not do_reshard:
             raise ValueError(
                 f"mesh layout mismatch restoring {ckdir}: checkpoint was "
                 f"written with layout {_fmt_layout(saved_layout)} but the "
                 f"restore requested {_fmt_layout(want)} — shards do not line "
-                "up; restore with a matching SpecLayout/Partitioner "
-                "(cross-layout resharding is ROADMAP item 5)")
+                "up; restore with a matching SpecLayout/Partitioner, or pass "
+                "reshard=True to redistribute the saved chunks onto the new "
+                "layout (ISSUE 14 cross-topology restore)")
         shard_files = sorted(f for f in os.listdir(ckdir)
                              if f.startswith("shard_") and f.endswith(".npz"))
         expected = int(meta.get("process_count", 1))
@@ -321,8 +375,18 @@ class TrainingCheckpointer:
                 f"partial checkpoint in {ckdir}: {len(shard_files)} shard "
                 f"files for a {expected}-process save — a process was likely "
                 "killed mid-write; refusing to restore silently-zeroed weights")
-        if saved_layout is not None:
-            self._restore_sharded(net, ckdir, meta, shard_files)
+        t0 = time.perf_counter()
+        stats = {"bytes": 0}
+        if saved_layout is not None and self.partitioner is not None:
+            # same-layout AND cross-topology: both are chunk-intersection
+            # restores onto the partitioner's mesh; resharding only relaxes
+            # the chunks-line-up-1:1 guarantee
+            self._restore_sharded(net, ckdir, meta, shard_files, stats=stats)
+        elif saved_layout is not None:
+            # sharded checkpoint, replicated target (reshard=True verified
+            # above): a replicated net holds every full array by definition,
+            # so host-side assembly IS the target placement
+            self._restore_assembled(net, ckdir, meta, shard_files)
         else:
             self._restore_assembled(net, ckdir, meta, shard_files)
             if self.partitioner is not None:
@@ -331,6 +395,9 @@ class TrainingCheckpointer:
                 # if the trainer fitted before this restore — params would
                 # silently stay replicated, defeating the layout)
                 self.partitioner.partition_net(net)
+        if resharding:
+            self._note_reshard(saved_layout, want, stats["bytes"],
+                               time.perf_counter() - t0, tag)
         net.iteration = meta["iteration"]
         net.epoch = meta["epoch"]
         if iterator is not None and "iterator" in meta and hasattr(iterator, "set_state"):
@@ -338,6 +405,21 @@ class TrainingCheckpointer:
         flight.record("ckpt_restore", tag=tag, iteration=meta["iteration"],
                       epoch=meta["epoch"])
         return True
+
+    def _note_reshard(self, saved_layout, want, nbytes: int, seconds: float,
+                      tag: str) -> None:
+        """Cross-topology restores are priced, not silent: counter + wall
+        histogram (ISSUE 14 satellite) and a flight breadcrumb naming both
+        layouts so a resize postmortem shows what the restore cost."""
+        from ..monitoring.partition import elastic_metrics
+
+        m = elastic_metrics()
+        m.reshard_bytes.inc(nbytes)
+        m.reshard_seconds.observe(seconds)
+        flight.record("ckpt_reshard", tag=tag,
+                      from_layout=_fmt_layout(saved_layout),
+                      to_layout=_fmt_layout(want),
+                      bytes=int(nbytes), seconds=round(seconds, 4))
 
     def _check_save_id(self, npz, ckdir, fname, meta):
         sid = int(npz["__save_id__"]) if "__save_id__" in npz.files else None
@@ -353,8 +435,12 @@ class TrainingCheckpointer:
                 and not k.endswith("|shape")]
 
     def _restore_assembled(self, net, ckdir, meta, shard_files):
-        """Replicated-layout path: reassemble each global array host-side;
-        the trainer's normal placement re-shards afterwards."""
+        """Replicated-target path: reassemble each global array host-side —
+        a replicated net holds every full array by definition, so this is
+        the one restore path where full-array materialization is the
+        CONTRACT, not a leak (the reshard lint's gather-ok carve-out). The
+        trainer's normal placement re-shards afterwards when a partitioner
+        is attached."""
         import jax.numpy as jnp
 
         assembled: Dict[str, np.ndarray] = {}
@@ -373,19 +459,30 @@ class TrainingCheckpointer:
                 "bn": net.bn_state}
         for path, arr in assembled.items():
             top, rest = path.split("/", 1)
+            cur = _get_leaf(tops[top], rest)
+            if cur is not None and hasattr(cur, "dtype") and \
+                    tuple(np.shape(cur)) != arr.shape:
+                raise ValueError(
+                    f"param-shape mismatch restoring {ckdir}: {path!r} was "
+                    f"saved as {arr.shape} but the net declares "
+                    f"{tuple(np.shape(cur))} — no restore (resharding or "
+                    "not) can reconcile a shape change")
             tops[top] = _set_leaf(tops[top], rest, jnp.asarray(arr))
         net.params_, net.updater_state, net.bn_state = (
             tops["params"], tops["updater"], tops["bn"])
 
-    def _restore_sharded(self, net, ckdir, meta, shard_files):
-        """Same-layout path: each leaf is rebuilt as a GLOBAL sharded array
-        via ``jax.make_array_from_callback`` — every rank fills only its
-        addressable shards by copying the overlapping saved chunks (all
-        shard files are indexed, but a chunk is only decompressed when a
-        local shard overlaps it). No rank materializes a full array: the
-        memory-efficient redistribution constraint of arXiv:2112.01075,
-        trivially satisfiable here because save and restore layouts are
-        verified identical, so chunks line up 1:1."""
+    def _restore_sharded(self, net, ckdir, meta, shard_files, stats=None):
+        """Sharded-target path, same-layout AND cross-topology: each leaf is
+        rebuilt as a GLOBAL sharded array via ``jax.make_array_from_callback``
+        — every rank fills only its addressable shards by copying the
+        overlapping saved chunks (all shard files are indexed, but a chunk is
+        only decompressed when a local shard overlaps it). No rank
+        materializes a full array: the memory-efficient redistribution
+        constraint of arXiv:2112.01075. When save and restore layouts are
+        identical the chunks line up 1:1; when they differ (``reshard=True``)
+        the intersection copy redistributes them — and genuinely incompatible
+        checkpoints (shape drift, missing chunks, non-tiling coverage) fail
+        loudly instead of restoring garbage."""
         import jax
 
         specs = self.partitioner.state_specs(net)
@@ -400,10 +497,20 @@ class TrainingCheckpointer:
                 for key in self._data_keys(npz):
                     path = key.rsplit("|", 1)[0]
                     index.setdefault(path, []).append(
+                        # gather-ok: shard-index metadata (ints), not arrays
                         (np.asarray(npz[f"{key}|idx"]),
                          tuple(int(s) for s in npz[f"{key}|shape"]), npz, key))
             tops = {"params": net.params_, "updater": net.updater_state,
                     "bn": net.bn_state}
+            missing = [p for p in spec_map if p not in index
+                       and hasattr(_get_leaf(
+                           tops.get(p.split("/", 1)[0], {}),
+                           p.split("/", 1)[1] if "/" in p else ""), "dtype")]
+            if missing:
+                raise ValueError(
+                    f"checkpoint {ckdir} is missing chunks for state the "
+                    f"current net declares: {sorted(missing)} — model drift "
+                    "between save and restore; resharding cannot invent them")
             for path, chunks in index.items():
                 if path not in spec_map:
                     raise ValueError(
@@ -411,12 +518,20 @@ class TrainingCheckpointer:
                         "current net/layout does not declare — model/layout "
                         "drift between save and restore")
                 shape = chunks[0][1]
+                top, rest = path.split("/", 1)
+                cur = _get_leaf(tops[top], rest)
+                if cur is not None and hasattr(cur, "dtype") and \
+                        tuple(np.shape(cur)) != shape:
+                    raise ValueError(
+                        f"param-shape mismatch restoring {ckdir}: {path!r} "
+                        f"was saved as {shape} but the net declares "
+                        f"{tuple(np.shape(cur))} — resharding redistributes "
+                        "shards, it cannot reconcile a shape change")
                 sharding = self.partitioner.sharding_for(spec_map[path])
                 arr = jax.make_array_from_callback(
                     shape, sharding,
                     lambda idx, c=chunks, s=shape, p=path:
-                        _fill_from_chunks(idx, c, s, p))
-                top, rest = path.split("/", 1)
+                        _fill_from_chunks(idx, c, s, p, stats=stats))
                 tops[top] = _set_leaf(tops[top], rest, arr)
             net.params_, net.updater_state, net.bn_state = (
                 tops["params"], tops["updater"], tops["bn"])

@@ -29,6 +29,16 @@ Three cooperating parts:
 - **the supervisor/monitor** — liveness + heartbeat-staleness polling,
   bounded per-replica respawn with exponential backoff, reconciliation of
   live replicas against the DESIRED size, and the ``tdl_pool_*`` gauges.
+  Scale-downs and swaps DRAIN before they signal (ISSUE 14): the router
+  stops dispatching (state ``draining``), in-flight requests finish, then
+  SIGTERM — no request ever races into a dying replica.
+
+:meth:`ServingPool.swap_model` (ISSUE 14) rolls a new checkpoint through
+the pool replica-by-replica with zero downtime: surge-spawn one replica on
+the new version (warm from the shared persistent compile cache), validate
+it behind the existing ``/ready`` aggregation, drain one old replica, and
+repeat — the pool never drops below the desired ready count, and a version
+that cannot serve rolls back before any old replica is touched.
 
 :class:`PoolAutoscaler` closes the ISSUE 9 loop: ``AlertEngine`` rules
 (queue-depth HWM, windowed p99, burn rate, shed rate — with their v2
@@ -47,7 +57,7 @@ import subprocess
 import sys
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -64,6 +74,9 @@ log = logging.getLogger(__name__)
 
 ENV_REPLICA_ID = "TDL_REPLICA_ID"
 ENV_PORT_FILE = "TDL_REPLICA_PORT_FILE"
+#: checkpoint handed to replica targets by swap_model (ISSUE 14) — targets
+#: read it at build time; a respawned replica keeps ITS version's value
+ENV_MODEL_CKPT = "TDL_MODEL_CKPT"
 
 #: delta-seconds hint on router 503s (matches json_server.RETRY_AFTER_S)
 RETRY_AFTER_S = 1
@@ -138,10 +151,13 @@ class ReplicaHandle:
     id: int
     proc: Optional[subprocess.Popen] = None
     port: Optional[int] = None
-    state: str = "starting"          # starting | ready | unready | dead
+    state: str = "starting"          # starting|ready|unready|draining|dead
     spawned_at: float = 0.0
     restarts: int = 0
     retiring: bool = False
+    surge: bool = False              # swap-roll extra: not a desired seat
+    signaled: bool = False           # SIGTERM sent (drain complete/forced)
+    drain_deadline: float = 0.0      # forced-signal time for a drain
     inflight: int = 0                # router's in-flight count (least-loaded)
     fails: int = 0                   # consecutive breaker failures
     breaker_open_until: float = 0.0
@@ -150,6 +166,8 @@ class ReplicaHandle:
     hb_dir: str = ""                 # per-INCARNATION (see _spawn_replica)
     last_hb: Optional[Tuple[int, float]] = None
     hb_changed_at: float = 0.0
+    #: per-replica env (the model version): survives respawns of THIS handle
+    env_overrides: Dict[str, str] = field(default_factory=dict)
 
     @property
     def alive(self) -> bool:
@@ -185,6 +203,8 @@ class ServingPool:
                  breaker_threshold: int = 3, breaker_cooldown: float = 1.0,
                  request_timeout: float = 40.0,
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
+                 drain_grace: float = 45.0,
+                 swap_ready_timeout: float = 180.0,
                  registry: Optional[MetricsRegistry] = None):
         if not (1 <= min_replicas <= max_replicas):
             raise ValueError(f"need 1 <= min_replicas <= max_replicas, got "
@@ -210,6 +230,12 @@ class ServingPool:
         self.breaker_cooldown = breaker_cooldown
         self.request_timeout = request_timeout
         self.max_body_bytes = max_body_bytes
+        self.drain_grace = drain_grace
+        self.swap_ready_timeout = swap_ready_timeout
+        #: env applied to NEW replica handles (the current model version);
+        #: swap_model updates it on success so scale-ups spawn the new model
+        self._default_overrides: Dict[str, str] = {}
+        self._swap_lock = threading.Lock()
         import tempfile
 
         self.workdir = workdir or tempfile.mkdtemp(prefix="tdl_pool_")
@@ -320,6 +346,176 @@ class ServingPool:
                  reason or "manual")
         return n
 
+    # -- zero-downtime model swap (ISSUE 14) -------------------------------
+
+    def swap_model(self, ckpt: Optional[str] = None, *,
+                   env: Optional[Dict[str, str]] = None,
+                   ready_timeout: Optional[float] = None) -> dict:
+        """Roll every replica onto a new model version with zero downtime.
+
+        ``ckpt`` lands in the replicas' env as ``TDL_MODEL_CKPT`` (targets
+        read it at build time); ``env`` passes arbitrary extra version env.
+        Surge-style roll, one replica at a time:
+
+        1. spawn ONE extra replica on the new version (it warms from the
+           shared persistent compile cache, so this is deserialization plus
+           a restore, not an XLA compile),
+        2. wait until it is READY behind the existing ``/ready`` aggregation
+           — this is the swap validation: a version that cannot serve never
+           touches the old fleet,
+        3. DRAIN one old replica (the router stops dispatching first, its
+           in-flight requests finish, then SIGTERM — the satellite drain
+           fix), and repeat.
+
+        The pool therefore never drops below ``desired`` ready replicas (let
+        alone ``min_replicas``). A surge replica that fails validation is
+        killed and the swap ROLLS BACK with the old version fully serving
+        (``tdl_pool_swap_rollbacks_total``); validation happens before the
+        first old replica is touched, so a bad checkpoint cannot degrade the
+        pool at all. Returns ``{"ok", "swapped", "rolled_back", "window_s"}``.
+        """
+        overrides = dict(env or {})
+        if ckpt is not None:
+            overrides[ENV_MODEL_CKPT] = str(ckpt)
+        if not overrides:
+            raise ValueError("swap_model needs a checkpoint path or env")
+        if not self._swap_lock.acquire(blocking=False):
+            raise RuntimeError("a model swap is already in progress")
+        t0 = time.perf_counter()
+        swapped = 0
+
+        def carries_new(h: ReplicaHandle) -> bool:
+            return all(h.env_overrides.get(k) == v
+                       for k, v in overrides.items())
+
+        try:
+            flight.record("pool_swap_begin",
+                          model=overrides.get(ENV_MODEL_CKPT))
+            with self._lock:
+                # the new version becomes the pool default IMMEDIATELY: a
+                # concurrent autoscaler scale-up or seat backfill mid-roll
+                # must spawn the NEW model, not quietly re-introduce the old
+                # one outside the roll's snapshot (reverted on rollback)
+                prev_defaults = dict(self._default_overrides)
+                self._default_overrides.update(overrides)
+            # convergence loop, not a fixed snapshot: roll until no serving
+            # replica still carries the old version (mid-roll deaths respawn
+            # with THEIR handle's old env and re-enter the pending set)
+            max_rolls = 2 * self.max_replicas + 4
+            while True:
+                with self._lock:
+                    pending = [h for h in self._replicas.values()
+                               if not h.retiring and not h.surge
+                               and not carries_new(h)]
+                    if not pending:
+                        break
+                    if swapped >= max_rolls:
+                        raise RuntimeError(
+                            f"model swap did not converge after {swapped} "
+                            "rolls — replicas keep appearing on the old "
+                            "version")
+                    old = min(pending, key=lambda h: h.id)
+                    surge = self._spawn_replica(
+                        env_overrides=dict(overrides), surge=True)
+                if not self._await_replica_ready(
+                        surge, ready_timeout if ready_timeout is not None
+                        else self.swap_ready_timeout):
+                    self._rollback_swap(surge, overrides, prev_defaults,
+                                        swapped)
+                    return {"ok": False, "swapped": swapped,
+                            "rolled_back": True,
+                            "window_s": round(time.perf_counter() - t0, 3)}
+                with self._lock:
+                    # promote + drain under ONE lock hold: a reconcile pass
+                    # between the two would see desired+1 serving replicas
+                    # and drain the highest id — the replica just promoted
+                    surge.surge = False
+                    self._begin_drain(old, reason="model swap")
+                self._await_gone(old, self.drain_grace + 15.0)
+                swapped += 1
+            self._m.swap_events.inc()
+            window = round(time.perf_counter() - t0, 3)
+            flight.record("pool_swap", swapped=swapped, window_s=window,
+                          model=overrides.get(ENV_MODEL_CKPT))
+            log.info("model swap complete: %d replicas rolled in %.2fs",
+                     swapped, window)
+            return {"ok": True, "swapped": swapped, "rolled_back": False,
+                    "window_s": window}
+        finally:
+            self._swap_lock.release()
+
+    def _rollback_swap(self, surge: ReplicaHandle, overrides, prev_defaults,
+                       swapped: int) -> None:
+        """Undo a failed validation: kill the surge, restore the previous
+        default version for future spawns, and point any not-yet-ready
+        replica that was spawned mid-roll on the broken version back at the
+        old one (its next respawn reverts; replicas already READY on the new
+        version keep it — they demonstrably serve)."""
+        self._retire_now(surge)
+        with self._lock:
+            self._default_overrides = dict(prev_defaults)
+            for h in self._replicas.values():
+                if h.state != "ready" and all(
+                        h.env_overrides.get(k) == v
+                        for k, v in overrides.items()):
+                    h.env_overrides = dict(prev_defaults)
+        self._m.swap_rollbacks.inc()
+        flight.record("pool_swap_rollback", replica=surge.id,
+                      swapped=swapped,
+                      model=overrides.get(ENV_MODEL_CKPT))
+        log.error(
+            "model swap rolled back: new-version replica %d never became "
+            "ready (%d replicas already rolled keep the new version; the "
+            "rest keep serving the old one)", surge.id, swapped)
+
+    def _await_replica_ready(self, h: ReplicaHandle, timeout: float) -> bool:
+        """Wait for ONE replica to probe ready; fail fast when its process
+        dies (a crashing new version should not burn the whole timeout)."""
+        r0 = h.restarts
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if h.state == "ready":
+                return True
+            if h.state == "dead" or h.restarts > r0 or not h.alive:
+                return False
+            time.sleep(0.02)
+        return False
+
+    def _retire_now(self, h: ReplicaHandle) -> None:
+        """Kill + remove a replica that never served (failed surge): no
+        drain needed, nothing is in flight on it by construction."""
+        with self._lock:
+            h.retiring = True
+            h.signaled = True
+            self._replicas.pop(h.id, None)
+        if h.proc is not None:
+            if h.alive:
+                try:
+                    h.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+            try:
+                h.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                h.proc.wait(timeout=10)
+
+    def _await_gone(self, h: ReplicaHandle, timeout: float) -> None:
+        """Wait for a draining replica to exit and be reaped; force-kill at
+        the deadline so a wedged old replica cannot hang the swap."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if h.id not in self._replicas:
+                    return
+            time.sleep(0.02)
+        log.warning("replica %d outlived its drain window — force killing",
+                    h.id)
+        if h.alive:
+            h.proc.kill()
+        with self._lock:
+            self._replicas.pop(h.id, None)
+
     # -- introspection -----------------------------------------------------
 
     @property
@@ -346,7 +542,8 @@ class ServingPool:
                 "replicas": [{
                     "id": h.id, "state": h.state, "port": h.port,
                     "inflight": h.inflight, "restarts": h.restarts,
-                    "retiring": h.retiring,
+                    "retiring": h.retiring, "surge": h.surge,
+                    "model": h.env_overrides.get(ENV_MODEL_CKPT),
                     "breaker_open": not h.breaker_closed(time.monotonic()),
                 } for h in self._replicas.values()],
             }
@@ -379,6 +576,9 @@ class ServingPool:
         looks, a kill/respawn loop at startup_grace expiry."""
         env = dict(os.environ)
         env.update(self.extra_env)
+        # per-handle model version (swap_model): after the identity block
+        # below it could shadow pool-owned keys, so it applies FIRST
+        env.update(handle.env_overrides)
         env[ENV_REPLICA_ID] = str(handle.id)
         env[ENV_PORT_FILE] = handle.port_file
         repo_root = os.path.dirname(os.path.dirname(
@@ -399,11 +599,20 @@ class ServingPool:
         env.setdefault(compile_cache.ENV_DIR, self.compile_cache_dir)
         return env
 
-    def _spawn_replica(self, handle: Optional[ReplicaHandle] = None) -> ReplicaHandle:
+    def _spawn_replica(self, handle: Optional[ReplicaHandle] = None,
+                       env_overrides: Optional[Dict[str, str]] = None,
+                       surge: bool = False) -> ReplicaHandle:
         """Spawn a new replica (fresh id) or respawn an existing handle's
-        process in place. Caller holds the lock."""
+        process in place. New handles inherit the pool's current model
+        version (``_default_overrides``) unless ``env_overrides`` pins one;
+        ``surge=True`` marks a swap-roll extra that must not count as a
+        desired seat. Caller holds the lock."""
         if handle is None:
             handle = ReplicaHandle(id=self._next_id)
+            handle.env_overrides = dict(self._default_overrides
+                                        if env_overrides is None
+                                        else env_overrides)
+            handle.surge = surge
             self._next_id += 1
             self._replicas[handle.id] = handle
         handle.port_file = os.path.join(
@@ -418,6 +627,8 @@ class ServingPool:
         handle.port = None
         handle.state = "starting"
         handle.retiring = False
+        handle.signaled = False
+        handle.drain_deadline = 0.0
         handle.fails = 0
         handle.breaker_open_until = 0.0
         handle.last_hb = None
@@ -449,24 +660,37 @@ class ServingPool:
 
     def _reconcile(self) -> None:
         """Drive the live replica set toward ``desired``: spawn the missing,
-        retire the surplus (highest ids first — graceful SIGTERM drain)."""
+        DRAIN the surplus (highest ids first). Surge replicas (a swap roll
+        in flight) are not desired seats — they neither satisfy the count
+        nor get retired by it."""
         with self._lock:
-            serving = [h for h in self._replicas.values() if not h.retiring]
+            serving = [h for h in self._replicas.values()
+                       if not h.retiring and not h.surge]
             if len(serving) < self.desired:
                 for _ in range(self.desired - len(serving)):
                     self._spawn_replica()
             elif len(serving) > self.desired:
                 for h in sorted(serving, key=lambda h: -h.id)[
                         :len(serving) - self.desired]:
-                    h.retiring = True
-                    h.state = "unready"
-                    if h.alive:
-                        try:
-                            h.proc.send_signal(signal.SIGTERM)
-                        except OSError:
-                            log.debug("retire race on replica %d", h.id)
-                    flight.record("replica_retire", replica=h.id)
-                    log.info("retiring replica %d (scale down)", h.id)
+                    self._begin_drain(h, reason="scale down")
+
+    def _begin_drain(self, h: ReplicaHandle, reason: str) -> None:
+        """ISSUE 14 satellite (the drain-before-signal fix): the ROUTER
+        stops dispatching to the replica FIRST — retiring/draining replicas
+        are excluded from ``_pick_replica`` under the same lock that admits
+        in-flight requests — and only once its in-flight count hits zero (or
+        ``drain_grace`` expires) does the monitor send SIGTERM. Before this,
+        a request could race into a replica that was already being signaled,
+        die on the closing socket, and burn a breaker count + a failover on
+        a perfectly healthy pool transition."""
+        with self._lock:
+            if h.retiring:
+                return
+            h.retiring = True
+            h.state = "draining"
+            h.drain_deadline = time.monotonic() + self.drain_grace
+        flight.record("replica_retire", replica=h.id, reason=reason)
+        log.info("draining replica %d (%s)", h.id, reason)
 
     def _poll_replicas(self) -> None:
         now = time.monotonic()
@@ -478,6 +702,20 @@ class ServingPool:
                 if not h.alive:
                     with self._lock:
                         self._replicas.pop(h.id, None)
+                    continue
+                if not h.signaled:
+                    with self._lock:
+                        idle = h.inflight == 0
+                        forced = now >= h.drain_deadline
+                        if idle or forced:
+                            h.signaled = True
+                    if idle or forced:
+                        try:
+                            h.proc.send_signal(signal.SIGTERM)
+                        except OSError:
+                            log.debug("drain-signal race on replica %d", h.id)
+                        flight.record("replica_drain_complete", replica=h.id,
+                                      forced=bool(forced and not idle))
                 continue
             if not h.alive:
                 self._on_death(h, "replica_crash", now)
@@ -525,6 +763,12 @@ class ServingPool:
             h.next_spawn_at = now + backoff
         elif now >= h.next_spawn_at:
             with self._lock:
+                # re-check under the lock: a swap rollback's _retire_now can
+                # pop the handle between this poll's snapshot and here —
+                # respawning a popped handle would launch a process nothing
+                # ever polls, signals, or reaps
+                if h.retiring or h.id not in self._replicas:
+                    return
                 h.restarts += 1
                 self._spawn_replica(h)
 
@@ -568,7 +812,7 @@ class ServingPool:
     #: the full state domain — the gauge emits 0 for a replica's OTHER
     #: states (as its help text promises), so alert/dashboard expressions
     #: like {state="dead"} == 0 match instead of seeing a missing series
-    _STATES = ("starting", "ready", "unready", "dead")
+    _STATES = ("starting", "ready", "unready", "draining", "dead")
 
     def _update_gauges(self) -> None:
         with self._lock:
@@ -584,7 +828,10 @@ class ServingPool:
     # -- router ------------------------------------------------------------
 
     def _pick_replica(self, exclude) -> Optional[ReplicaHandle]:
-        """Least-loaded dispatch over ready, breaker-closed replicas."""
+        """Least-loaded dispatch over ready, breaker-closed replicas. The
+        in-flight count is taken UNDER the same lock that excludes draining
+        replicas, so _begin_drain can trust inflight==0: no request can be
+        between "picked" and "counted" when the drain decision is made."""
         now = time.monotonic()
         with self._lock:
             ok = [h for h in self._replicas.values()
@@ -593,7 +840,9 @@ class ServingPool:
                   and h.breaker_closed(now)]
             if not ok:
                 return None
-            return min(ok, key=lambda h: (h.inflight, h.id))
+            h = min(ok, key=lambda h: (h.inflight, h.id))
+            h.inflight += 1
+            return h
 
     def _note_success(self, h: ReplicaHandle) -> None:
         with self._lock:
@@ -747,12 +996,10 @@ class ServingPool:
         with self._lock:
             n_live = max(1, len(self._replicas))
         for _ in range(n_live):
-            h = self._pick_replica(tried)
+            h = self._pick_replica(tried)  # also counts us in-flight on h
             if h is None:
                 break
             tried.add(h.id)
-            with self._lock:
-                h.inflight += 1
             try:
                 req = urllib.request.Request(
                     f"http://127.0.0.1:{h.port}{self.endpoint}",

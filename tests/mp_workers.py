@@ -456,6 +456,10 @@ def fsdp_train():
       reads only its shards) and writes the param fingerprint — the parent
       asserts exact parity with the trained gang, and that a mismatched
       TDL_MP_FSDP/TDL_MP_TP gang dies with the layout-mismatch error.
+      ``TDL_MP_RESHARD=1`` opts the restore into the ISSUE 14 cross-topology
+      path: a DIFFERENT gang shape/layout redistributes the saved chunks
+      instead of refusing (each rank still reads only the chunk slices
+      overlapping its addressable shards).
 
     Every rank reports ``tdl_param_bytes_per_rank`` so the parent can assert
     per-rank bytes shrink ~linearly with the fsdp axis size."""
@@ -501,7 +505,8 @@ def fsdp_train():
 
     losses = []
     if mode == "restore":
-        if not ck or not ck.restore(net):  # mismatch raises BEFORE here
+        reshard = os.environ.get("TDL_MP_RESHARD") == "1"
+        if not ck or not ck.restore(net, reshard=reshard):
             raise RuntimeError("restore mode found no checkpoint")
         trainer._place_net()  # pass-through: shards already placed
     else:
@@ -529,6 +534,90 @@ def fsdp_train():
         "bytes_opt": m.param_bytes.labels("opt_state").value,
         "params_bytes_total": rep.params_bytes_total,
         "local_devices": jax.local_device_count(),
+        "mesh": {a: int(s) for a, s in trainer.mesh.shape.items()},
+        "global_devices": jax.device_count(),
+    })
+
+
+def elastic_train():
+    """ISSUE 14 elastic-resize target: a sharded gang that adapts to
+    WHATEVER world size the supervisor spawned.
+
+    - layout = ``largest_layout(total devices)`` (fsdp absorbs them all), so
+      a resized gang builds a valid smaller mesh without reconfiguration;
+    - restore is unconditional with ``reshard=True``: after an elastic
+      resize the survivors inherit the bigger gang's checkpoint through the
+      cross-topology chunk redistribution;
+    - the permanently-dead host is simulated by TDL_MP_DEAD_RANK: that rank
+      ``os._exit``s at BOOT (before jax / any heartbeat) in every respawn
+      (incarnation >= 1) while the world is still larger than
+      TDL_MP_SURVIVORS — exactly a host that never comes back. Once the
+      supervisor degrades the gang to the survivor count, the env rank ids
+      renumber below the dead one and training continues unattended.
+    """
+    incarnation = int(os.environ.get("TDL_GANG_RESTART_COUNT", "0"))
+    env_rank = int(os.environ.get("TDL_PROCESS_ID", "0"))
+    env_world = int(os.environ.get("TDL_NUM_PROCESSES", "1"))
+    dead = os.environ.get("TDL_MP_DEAD_RANK")
+    survivors = int(os.environ.get("TDL_MP_SURVIVORS", "1"))
+    if (dead is not None and env_rank == int(dead) and incarnation >= 1
+            and env_world > survivors):
+        os._exit(43)  # the "host" is gone: no boot, no heartbeat, ever
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+    from deeplearning4j_tpu.parallel.partition import (Partitioner,
+                                                       largest_layout)
+    from deeplearning4j_tpu.parallel.trainer import MultiProcessTrainer
+
+    col = ProcessCollectives()
+    rank, world = col.rank, col.world
+    steps = int(os.environ.get("TDL_MP_STEPS", "8"))
+    every = int(os.environ.get("TDL_MP_CKPT_EVERY", "2"))
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    partitioner = Partitioner(largest_layout(jax.device_count()))
+    trainer = MultiProcessTrainer(net, mesh_layout=partitioner)
+    ck = trainer.checkpointer(os.environ["TDL_MP_CKPT"], async_write=False,
+                              reshard=True)
+    start = 0
+    if ck.restore(net):  # cross-topology after a resize; False on a cold dir
+        start = int(net.iteration)
+        trainer._place_net()
+
+    def batch(step, n=8):
+        rs = np.random.RandomState(2000 + step)
+        x = rs.rand(n, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, n)]
+        return x, y
+
+    for step in range(start, steps):
+        x, y = batch(step)
+        trainer.fit([DataSet(x, y)])  # data axis = 1: full global batch
+        if (step + 1) % every == 0:
+            col.barrier(f"el-ck-{step}")
+            ck.save(net)
+            col.barrier(f"el-ck-done-{step}")
+
+    psum = float(sum(jnp.sum(w) for w in jax.tree.leaves(net.params_)))
+    pnorm = float(jnp.sqrt(sum(jnp.sum(jnp.square(w))
+                               for w in jax.tree.leaves(net.params_))))
+    col.barrier("el-done")
+    _write(rank, {
+        "param_sum": psum, "param_norm": pnorm,
+        "iteration": int(net.iteration), "start": start,
+        "world": world, "incarnation": incarnation,
         "mesh": {a: int(s) for a, s in trainer.mesh.shape.items()},
         "global_devices": jax.device_count(),
     })

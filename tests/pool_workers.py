@@ -82,6 +82,40 @@ def stub_server():
         warmup_input=np.zeros((1, 4), np.float32))
 
 
+class ScaledModel:
+    """output(x) = scale·x — the 'model version' is the scale factor."""
+
+    def __init__(self, scale):
+        self.scale = float(scale)
+
+    def output(self, x):
+        return np.asarray(x, np.float32) * self.scale
+
+
+def swappable_server():
+    """Versioned inference replica (ISSUE 14 swap tests): the model version
+    rides ``TDL_MODEL_CKPT`` — a json file ``{"scale": k}`` (``{"fail":
+    true}`` simulates a checkpoint the new build cannot load, the swap
+    validation-failure path). No env = the historical 2x model."""
+    import json as _json
+
+    from deeplearning4j_tpu.serving import JsonModelServer
+
+    _maybe_start_delay()
+    ckpt = os.environ.get("TDL_MODEL_CKPT")
+    scale = 2.0
+    if ckpt:
+        with open(ckpt) as f:
+            doc = _json.load(f)
+        if doc.get("fail"):
+            raise RuntimeError(f"injected model-load failure from {ckpt}")
+        scale = float(doc["scale"])
+    return JsonModelServer(
+        ScaledModel(scale), port=0,
+        max_queue=int(os.environ.get("TDL_STUB_QUEUE", "64")),
+        warmup_input=np.zeros((1, 4), np.float32))
+
+
 def generative_stub_server():
     """Continuous-batching generative replica over the stub session."""
     from deeplearning4j_tpu.serving import JsonModelServer

@@ -238,6 +238,45 @@ def test_fsdp_sharded_checkpoint_roundtrip_and_mismatch(tmp_path):
     assert "fsdp=4" in blob and "fsdp=2" in blob  # names BOTH layouts
 
 
+@pytest.mark.slow
+def test_cross_topology_gang_restore_parity(tmp_path):
+    """ISSUE 14 acceptance (the mp tier of the restore-parity matrix): a
+    4-rank fsdp=4 gang saves sharded checkpoints; a 2-rank fsdp=2 gang AND a
+    2-rank fsdp=2×tp=2 gang (layout change, 4 devices) restore them with
+    ``reshard=True`` — exact param fingerprint parity, each rank reading
+    only the saved chunk slices overlapping its addressable shards."""
+    ckdir = str(tmp_path / "ck")
+    base = {"TDL_MP_FSDP": "4", "TDL_MP_CKPT": ckdir, "TDL_MP_STEPS": "4",
+            "TDL_MP_CKPT_EVERY": "2"}
+    for d in ("a", "b", "c"):
+        (tmp_path / d).mkdir()
+    trained = _run("fsdp_train", tmp_path / "a", n=4, dev=1, extra_env=base)
+    assert trained[0]["mesh"] == {"data": 1, "fsdp": 4, "tp": 1}
+
+    # 4 ranks -> 2 ranks, same axis shape class (fsdp-only, half the devices)
+    down = _run("fsdp_train", tmp_path / "b", n=2, dev=1,
+                extra_env={**base, "TDL_MP_MODE": "restore",
+                           "TDL_MP_FSDP": "2", "TDL_MP_RESHARD": "1"})
+    # 4 ranks -> 2 ranks x 2 devices with an fsdp↔tp layout change
+    cross = _run("fsdp_train", tmp_path / "c", n=2, dev=2,
+                 extra_env={**base, "TDL_MP_MODE": "restore",
+                            "TDL_MP_FSDP": "2", "TDL_MP_TP": "2",
+                            "TDL_MP_RESHARD": "1"})
+    for restored, mesh in ((down, {"data": 1, "fsdp": 2, "tp": 1}),
+                           (cross, {"data": 1, "fsdp": 2, "tp": 2})):
+        for t, r in zip(trained, restored):
+            # the restored ARRAYS are bitwise-equal (pinned exactly by the
+            # tier-1 matrix in tests/test_reshard.py); the device-side
+            # fingerprint SUM reduces in sharding-dependent order, so the
+            # cross-layout fingerprints agree to f32 rounding, not bit-ly
+            np.testing.assert_allclose(r["param_sum"], t["param_sum"],
+                                       rtol=2e-6, atol=1e-5)
+            np.testing.assert_allclose(r["param_norm"], t["param_norm"],
+                                       rtol=2e-6)
+            assert r["iteration"] == t["iteration"] == 4
+        assert restored[0]["mesh"] == mesh
+
+
 def test_multiprocess_tp_matches_single_process(tmp_path):
     """Tensor-parallel axis SPANNING the process boundary (r5: VERDICT r4
     weak #7 — the multi-process tier previously proved DP numerics only)."""

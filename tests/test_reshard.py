@@ -1,0 +1,309 @@
+"""Cross-topology checkpoint resharding (ISSUE 14 tentpole piece 1).
+
+The restore-parity matrix, single-process over the 8-device CPU mesh: a
+checkpoint written under one SpecLayout restores under a DIFFERENT layout —
+fsdp↔tp changes, fewer devices, more devices, and down to replicated — with
+exact (bitwise) param + optimizer-state parity, via the source→target chunk
+intersection of arXiv:2112.01075 (each rank fills only its addressable
+shards from the overlapping saved chunk slices; no process materializes a
+full array — the AST lint at the bottom keeps that claim from rotting).
+
+Genuinely incompatible checkpoints stay loud: param-shape drift, missing
+chunks, and non-tiling coverage all raise naming the problem. The
+multi-process tier (real 4-rank → 2-rank gangs) rides
+tests/test_multiprocess.py::test_cross_topology_gang_restore_parity.
+"""
+
+import ast
+import pathlib
+import re
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.monitoring import get_registry
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.parallel import (ParallelTrainer, Partitioner,
+                                         SpecLayout, largest_layout)
+from deeplearning4j_tpu.parallel.mesh import mesh_from_shape
+from deeplearning4j_tpu.serde.checkpoint import (TrainingCheckpointer,
+                                                 _fill_from_chunks)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent / "deeplearning4j_tpu"
+
+
+def _mlp(seed=7, hidden=16):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=hidden, activation="tanh"))
+            .layer(DenseLayer(n_in=hidden, n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(steps=4, n=16):
+    out = []
+    for s in range(steps):
+        rs = np.random.RandomState(100 + s)
+        x = rs.rand(n, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, n)]
+        out.append(DataSet(x, y))
+    return out
+
+
+def _sub_partitioner(layout, n_devices):
+    """Partitioner over the FIRST n devices — how the matrix emulates a
+    smaller/larger target topology inside one process."""
+    return Partitioner(layout, mesh=mesh_from_shape(
+        layout.shape(), devices=jax.devices()[:n_devices]))
+
+
+def _trained_ckpt(tmp_path, layout=None, partitioner=None):
+    a = _mlp()
+    ta = ParallelTrainer(a, mesh_layout=partitioner
+                         if partitioner is not None else layout)
+    for ds in _batches():
+        ta._fit_batch(ds)
+    ck = ta.checkpointer(str(tmp_path), async_write=False)
+    ck.save(a)
+    return a
+
+
+def _assert_state_parity(a, b):
+    """Bitwise equality of params AND optimizer state — the structural-
+    mirror rule resharded the Adam m/v exactly like their params."""
+    for wa, wb in zip(jax.tree.leaves(a.params_), jax.tree.leaves(b.params_)):
+        np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
+    for ua, ub in zip(jax.tree.leaves(a.updater_state),
+                      jax.tree.leaves(b.updater_state)):
+        np.testing.assert_array_equal(np.asarray(ua), np.asarray(ub))
+    assert b.iteration == a.iteration
+
+
+# ------------------------------------------------------- the parity matrix
+
+
+@pytest.mark.parametrize("target_layout,target_devices", [
+    (SpecLayout(data=1, fsdp=4, tp=2), 8),   # fsdp↔tp change, same devices
+    (SpecLayout(data=1, fsdp=2, tp=2), 4),   # "restore on fewer ranks"
+    (SpecLayout(data=1, fsdp=2, tp=1), 2),   # even fewer
+    (SpecLayout(data=1, fsdp=8, tp=1), 8),   # "restore on more ranks"
+])
+def test_reshard_restore_matrix(tmp_path, target_layout, target_devices):
+    """A (2,2,2)-trained checkpoint restores under every target layout with
+    exact param+opt parity, lands SHARDED per the target layout, and
+    training continues bit-compatibly from the redistributed shards."""
+    a = _trained_ckpt(tmp_path, layout=SpecLayout(data=2, fsdp=2, tp=2))
+
+    b = _mlp(seed=99)  # different init — every leaf must be overwritten
+    part = _sub_partitioner(target_layout, target_devices)
+    tb = ParallelTrainer(b, mesh_layout=part)
+    assert tb.checkpointer(str(tmp_path), async_write=False).restore(
+        b, reshard=True)
+    tb._place_net()
+    _assert_state_parity(a, b)
+    spec = b.params_["0"]["W"].sharding.spec
+    want = part.spec_tree(b.params_)["0"]["W"]
+    assert spec == want, (spec, want)
+    # the redistributed state is a live training state, not just bytes
+    tb._fit_batch(_batches(steps=5)[-1])
+    assert np.isfinite(float(b.score_))
+
+
+def test_reshard_restore_to_replicated(tmp_path):
+    """Sharded → replicated with reshard=True: the one direction where a
+    full array per process is the CONTRACT (a replicated net holds them by
+    definition), assembled host-side."""
+    a = _trained_ckpt(tmp_path, layout=SpecLayout(data=1, fsdp=4, tp=2))
+    r = _mlp(seed=3)
+    assert TrainingCheckpointer(str(tmp_path), async_write=False,
+                                reshard=True).restore(r)
+    _assert_state_parity(a, r)
+
+
+def test_reshard_records_cost_metrics_and_flight(tmp_path):
+    before = {}
+    snap = get_registry().snapshot()
+    if "tdl_reshard_seconds" in snap:
+        before["n"] = snap["tdl_reshard_seconds"]["series"][0]["count"]
+        before["b"] = snap["tdl_reshard_bytes_total"]["series"][0]["value"]
+    a = _trained_ckpt(tmp_path, layout=SpecLayout(data=2, fsdp=2, tp=2))
+    b = _mlp(seed=99)
+    tb = ParallelTrainer(b, mesh_layout=SpecLayout(data=1, fsdp=4, tp=2))
+    assert tb.checkpointer(str(tmp_path), async_write=False).restore(
+        b, reshard=True)
+    snap = get_registry().snapshot()
+    assert snap["tdl_reshard_seconds"]["series"][0]["count"] == \
+        before.get("n", 0) + 1
+    moved = snap["tdl_reshard_bytes_total"]["series"][0]["value"] - \
+        before.get("b", 0)
+    # every param/opt/bn byte of the net moved through the intersection copy
+    assert moved >= sum(np.asarray(w).nbytes
+                        for w in jax.tree.leaves(a.params_))
+
+
+def test_mismatch_still_fails_loudly_without_optin(tmp_path):
+    """The PR 8 contract survives as the DEFAULT: reshard is opt-in, and the
+    refusal now tells the caller about it."""
+    _trained_ckpt(tmp_path, layout=SpecLayout(data=2, fsdp=2, tp=2))
+    c = _mlp()
+    tc = ParallelTrainer(c, mesh_layout=SpecLayout(data=1, fsdp=4, tp=2))
+    ck = tc.checkpointer(str(tmp_path), async_write=False)
+    with pytest.raises(ValueError) as ei:
+        ck.restore(c)
+    msg = str(ei.value)
+    assert "data=2 x fsdp=2 x tp=2" in msg and "data=1 x fsdp=4 x tp=2" in msg
+    assert "reshard=True" in msg
+    # explicit False overrides a reshard-by-default checkpointer too
+    ck2 = tc.checkpointer(str(tmp_path), async_write=False, reshard=True)
+    with pytest.raises(ValueError, match="mesh layout mismatch"):
+        ck2.restore(c, reshard=False)
+
+
+# ------------------------------------------- incompatible-checkpoint fallbacks
+
+
+def test_reshard_rejects_param_shape_drift(tmp_path):
+    _trained_ckpt(tmp_path, layout=SpecLayout(data=2, fsdp=2, tp=2))
+    wider = _mlp(hidden=24)
+    tw = ParallelTrainer(wider, mesh_layout=SpecLayout(data=1, fsdp=4, tp=2))
+    with pytest.raises(ValueError, match="shape"):
+        tw.checkpointer(str(tmp_path), async_write=False).restore(
+            wider, reshard=True)
+
+
+def test_reshard_rejects_missing_chunks(tmp_path):
+    """A net declaring state the checkpoint never saved (model drift) must
+    refuse — resharding redistributes chunks, it cannot invent them."""
+    _trained_ckpt(tmp_path, layout=SpecLayout(data=2, fsdp=2, tp=2))
+    deeper_conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+                   .list()
+                   .layer(DenseLayer(n_in=8, n_out=16, activation="tanh"))
+                   .layer(DenseLayer(n_in=16, n_out=16, activation="relu"))
+                   .layer(DenseLayer(n_in=16, n_out=16, activation="relu"))
+                   .layer(OutputLayer(n_out=4, activation="softmax",
+                                      loss="mcxent"))
+                   .set_input_type(InputType.feed_forward(8))
+                   .build())
+    deeper = MultiLayerNetwork(deeper_conf).init()
+    td = ParallelTrainer(deeper, mesh_layout=SpecLayout(data=1, fsdp=4, tp=2))
+    with pytest.raises(ValueError, match="missing chunks"):
+        td.checkpointer(str(tmp_path), async_write=False).restore(
+            deeper, reshard=True)
+
+
+def test_fill_from_chunks_verifies_tiling_coverage():
+    """The intersection copy counts cells: chunks that do not tile the leaf
+    (torn/foreign write) raise instead of silently restoring zeros."""
+    class _Npz(dict):
+        pass
+
+    npz = _Npz(a=np.arange(8, dtype=np.float32))
+    full = [(np.asarray([[0, 8]]), (8,), npz, "a")]
+    out = _fill_from_chunks((slice(2, 6),), full, (8,), "p")
+    np.testing.assert_array_equal(out, [2, 3, 4, 5])
+    hole = [(np.asarray([[0, 4]]), (8,), npz, "a")]
+    with pytest.raises(ValueError, match="4/8|cover"):
+        _fill_from_chunks((slice(0, 8),), hole, (8,), "p")
+
+
+def test_save_cleans_stale_shards_from_a_bigger_gang(tmp_path):
+    """ISSUE 14 aftermath hygiene: a smaller (post-resize) gang's save into
+    the same tag must remove the dead ranks' stale shard files — otherwise
+    the NEXT restore globs them, fails the save-id check, and a healthy
+    checkpoint reads as torn (the post-resize gang could never recover)."""
+    import shutil
+
+    a = _mlp()
+    ta = ParallelTrainer(a, mesh_layout=SpecLayout(data=2, fsdp=2, tp=2))
+    ta._fit_batch(_batches(1)[0])
+    ck = ta.checkpointer(str(tmp_path), async_write=False)
+    ck.save(a)
+    # plant the bigger gang's leftover: a rank-1 shard from an older save
+    shutil.copy(tmp_path / "latest" / "shard_0.npz",
+                tmp_path / "latest" / "shard_1.npz")
+    ta._fit_batch(_batches(2)[-1])
+    ck.save(a)
+    assert not (tmp_path / "latest" / "shard_1.npz").exists()
+    b = _mlp(seed=99)
+    tb = ParallelTrainer(b, mesh_layout=SpecLayout(data=2, fsdp=2, tp=2))
+    assert tb.checkpointer(str(tmp_path), async_write=False).restore(b)
+    _assert_state_parity(a, b)
+
+
+def test_largest_layout_picks_valid_meshes():
+    assert largest_layout(8) == SpecLayout(data=1, fsdp=8, tp=1)
+    assert largest_layout(8, tp=2) == SpecLayout(data=1, fsdp=4, tp=2)
+    assert largest_layout(6, tp=4) == SpecLayout(data=1, fsdp=2, tp=3)
+    assert largest_layout(1) == SpecLayout(data=1, fsdp=1, tp=1)
+    assert largest_layout(7, tp=2, data=2) == SpecLayout(data=1, fsdp=7, tp=1)
+    # the helper's output always builds (the supervisor hands it to workers)
+    assert largest_layout(8, tp=2).build_mesh().devices.size == 8
+
+
+# ------------------------------------------------------------------ AST lint
+
+
+_RESTORE_FN_RE = re.compile(r"restore|reshard|_fill_from_chunks|_place_leaf")
+_LINT_FILES = ("serde/checkpoint.py", "parallel/partition.py")
+
+
+def _full_array_offenders(src: str, rel: str):
+    """``np.asarray`` / ``jax.device_get`` call sites inside restore-path
+    functions without a ``# gather-ok:`` justification on the call line
+    or the line above it."""
+    lines = src.splitlines()
+    offenders = []
+    for node in ast.walk(ast.parse(src, filename=rel)):
+        if not (isinstance(node, ast.FunctionDef)
+                and _RESTORE_FN_RE.search(node.name)):
+            continue
+        for call in ast.walk(node):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in ("asarray", "device_get")
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id in ("np", "numpy", "jax")):
+                continue
+            context = lines[max(0, call.lineno - 2):call.lineno]
+            if any("gather-ok" in ln for ln in context):
+                continue
+            offenders.append(f"{rel}:{call.lineno} ({node.name})")
+    return offenders
+
+
+def test_no_full_array_in_restore_paths():
+    """ISSUE 14 satellite (repo lint): the no-full-array-on-one-host
+    constraint is a RESTORE-PATH invariant, and one convenient
+    ``np.asarray(params)`` / ``jax.device_get`` would silently rot it into
+    a gather. Ban both inside the restore/reshard/placement functions of
+    serde/checkpoint.py + parallel/partition.py unless the call line (or
+    the line above it) carries a ``# gather-ok: <reason>`` justification."""
+    offenders = []
+    for rel in _LINT_FILES:
+        offenders += _full_array_offenders((ROOT / rel).read_text(), rel)
+    assert not offenders, (
+        "full-array materialization in a restore path (annotate a genuinely "
+        "host-side/metadata site with `# gather-ok: <reason>`): "
+        f"{offenders}")
+
+
+def test_full_array_lint_catches_a_planted_offender():
+    planted = (
+        "import numpy as np\n"
+        "def _restore_sharded(net):\n"
+        "    ok = np.asarray(meta)  # gather-ok: metadata\n"
+        "    other = 1\n"
+        "    bad = np.asarray(net.params_)\n"
+        "def unrelated(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    hits = _full_array_offenders(planted, "planted.py")
+    assert hits == ["planted.py:5 (_restore_sharded)"]

@@ -247,6 +247,163 @@ def test_supervisor_detects_hang_well_before_gang_timeout(tmp_path):
     np.testing.assert_allclose(r0["param_sum"], ref_sum, rtol=1e-4, atol=1e-5)
 
 
+# ------------------------------------------------------- elastic resize (14)
+
+
+def _resize_supervisor(tmp_path, n=2, **kw):
+    from deeplearning4j_tpu.parallel.supervisor import GangEvent
+
+    kw.setdefault("elastic", True)
+    kw.setdefault("max_restarts", 2)
+    sup = GangSupervisor(f"{WORKERS}:elastic_train", n_processes=n,
+                         n_local_devices=2, workdir=str(tmp_path / "gang"),
+                         registry=MetricsRegistry(), **kw)
+    return sup, GangEvent
+
+
+def test_try_resize_degrades_to_survivors(tmp_path):
+    """The elastic decision logic, pinned without processes: the consistent
+    culprit set shrinks the gang, records the metric/flight entry and grants
+    a fresh budget; inconsistent culprits or a floor breach refuse."""
+    sup, GangEvent = _resize_supervisor(tmp_path, n=4)
+    sup._restarts_this_size = 2
+    sup.events = [GangEvent(1.0, "crash", 0, (1, 3), 5),
+                  GangEvent(2.0, "crash", 1, (3,), None),
+                  GangEvent(3.0, "crash", 2, (3,), None)]
+    assert sup._try_resize(sup.events[-1])
+    assert sup.n_processes == 3          # rank 3 was in EVERY failure
+    assert sup._restarts_this_size == 0  # fresh budget at the new size
+    assert sup.resizes[0]["suspect_ranks"] == [3]
+    assert sup.resizes[0]["from_processes"] == 4
+    assert sup.resizes[0]["to_processes"] == 3
+    # the survivor layout is the largest valid one for the remaining devices
+    assert sup.resizes[0]["survivor_layout"]["axes"]["fsdp"] == 6
+    snap = sup.registry.get("tdl_gang_resizes_total").snapshot()
+    assert [(s["labels"], s["value"]) for s in snap["series"]] == [
+        ({"direction": "down"}, 1.0)]
+
+
+def test_try_resize_ignores_bind_events_and_pre_resize_history(tmp_path):
+    """Only crash/hang failures AT the current size vote: a bind race (own
+    budget, implicates rank 0 by construction) must not poison the suspect
+    intersection, and events from before a previous resize carry renumbered
+    rank ids."""
+    sup, GangEvent = _resize_supervisor(tmp_path, n=2)
+    sup._restarts_this_size = 2
+    sup.events = [GangEvent(0.5, "bind", 0, (0,), None),
+                  GangEvent(1.0, "crash", 1, (1,), 3),
+                  GangEvent(1.5, "bind", 1, (0,), None),
+                  GangEvent(2.0, "crash", 2, (1,), None),
+                  GangEvent(3.0, "crash", 3, (1,), None)]
+    assert sup._try_resize(sup.events[-1])
+    assert sup.n_processes == 1
+    assert sup.resizes[0]["suspect_ranks"] == [1]
+    # events from the bigger gang are fenced off for the NEXT analysis
+    assert sup._events_mark == len(sup.events)
+
+
+def test_try_resize_refuses_without_consistent_culprit(tmp_path):
+    sup, GangEvent = _resize_supervisor(tmp_path, n=2)
+    # wandering ranks: no intersection — a software fault, not a dead host
+    sup.events = [GangEvent(1.0, "crash", 0, (0,), 3),
+                  GangEvent(2.0, "crash", 1, (1,), 3)]
+    assert not sup._try_resize(sup.events[-1])
+    assert sup.n_processes == 2 and sup.resizes == []
+
+
+def test_try_resize_respects_min_processes_and_elastic_flag(tmp_path):
+    sup, GangEvent = _resize_supervisor(tmp_path, n=2, min_processes=2)
+    ev = [GangEvent(1.0, "crash", 0, (1,), None)] * 3
+    sup.events = list(ev)
+    assert not sup._try_resize(ev[-1])   # floor: 1 survivor < min_processes
+    sup2, _ = _resize_supervisor(tmp_path, n=2, elastic=False)
+    sup2.events = list(ev)
+    assert not sup2._try_resize(ev[-1])  # elastic is opt-in
+
+
+@pytest.mark.slow
+def test_elastic_gang_resizes_to_survivors_and_finishes(tmp_path):
+    """ISSUE 14 acceptance: a rank whose 'host' never comes back (exits at
+    boot in every respawn) exhausts the restart budget; the supervisor
+    degrades the gang to the single survivor instead of classifying fatal,
+    the survivor restores the bigger gang's checkpoint CROSS-TOPOLOGY
+    (fsdp=4 shards onto the fsdp=2 survivor mesh) and finishes training
+    unattended; the postmortem records the resize."""
+    steps = 8
+    ckdir = tmp_path / "ckpt"
+    ckdir.mkdir()
+    env = {"TDL_MP_OUT": str(tmp_path / "out.json"),
+           "TDL_MP_CKPT": str(ckdir),
+           "TDL_MP_STEPS": str(steps), "TDL_MP_CKPT_EVERY": "2",
+           "TDL_MP_DEAD_RANK": "1", "TDL_MP_SURVIVORS": "1",
+           "TDL_MATMUL_PRECISION": "float32",
+           # incarnation 0 trains past a checkpoint, then loses rank 1;
+           # every later incarnation loses it at BOOT via TDL_MP_DEAD_RANK
+           "TDL_FAULT_SPEC": "crash@iter=3,rank=1"}
+    reg = MetricsRegistry()
+    sup = GangSupervisor(f"{WORKERS}:elastic_train", n_processes=2,
+                         n_local_devices=2, extra_env=env,
+                         workdir=str(tmp_path / "gang"),
+                         heartbeat_interval=0.0, backoff_base=0.1,
+                         kill_grace=1.0, max_restarts=2, elastic=True,
+                         min_processes=1, hang_timeout=60.0,
+                         startup_grace=300.0, registry=reg)
+    results = sup.run(timeout=540.0)
+    assert len(results) == 1  # the final gang IS the survivor gang
+    assert results[0].returncode == 0, results[0].stderr[-3000:]
+
+    assert sup.n_processes == 1
+    assert len(sup.resizes) == 1
+    rz = sup.resizes[0]
+    assert rz["from_processes"] == 2 and rz["to_processes"] == 1
+    assert rz["suspect_ranks"] == [1]
+    snap = reg.get("tdl_gang_resizes_total").snapshot()
+    assert snap["series"][0]["labels"] == {"direction": "down"}
+    assert snap["series"][0]["value"] == 1.0
+
+    # the postmortem (re-written at the resize decision) carries the story
+    with open(sup.postmortem_path) as f:
+        pm = json.load(f)
+    assert pm["classification"] == "elastic_resize"
+    assert pm["resizes"][0]["to_processes"] == 1
+    assert pm["resizes"][0]["suspect_ranks"] == [1]
+    assert pm["gang_size"] == 1
+
+    with open(str(tmp_path / "out.json") + ".rank0") as f:
+        r0 = json.load(f)
+    assert r0["world"] == 1
+    assert r0["start"] == 2      # restored the fsdp=4 ckpt from iteration 2
+    assert r0["iteration"] == steps
+    assert r0["mesh"]["fsdp"] == 2  # survivor mesh: 1 proc x 2 devices
+
+    # parity: steps 0-2 ran fsdp=4, the rest fsdp=2 — both match the
+    # replicated math, so the final params match a straight single run
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import (DenseLayer, InputType,
+                                            OutputLayer)
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    ref = MultiLayerNetwork(conf).init()
+    for step in range(steps):
+        rs = np.random.RandomState(2000 + step)
+        x = rs.rand(8, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 8)]
+        ref.fit(DataSet(x, y))
+    import jax.numpy as jnp
+
+    ref_sum = float(sum(jnp.sum(w) for w in jax.tree.leaves(ref.params_)))
+    np.testing.assert_allclose(r0["param_sum"], ref_sum, rtol=1e-4, atol=1e-5)
+
+
 @pytest.mark.slow
 def test_repeated_crash_same_iteration_is_fatal(tmp_path):
     """A deterministic fault (crash at the same iteration every incarnation)
