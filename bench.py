@@ -175,6 +175,8 @@ def _scale(on_tpu):
                                  slo_threshold_ms=1000.0, slo_target=0.99),
             "reshard": dict(features=64, hidden=512, classes=8, steps=4,
                             replicas=2),
+            "ckpt_lineage": dict(features=256, hidden=2048, classes=32,
+                                 steps=3, saves=4),
             "compile_cache": dict(features=64, classes=8, batch_limit=16,
                                   max_rows=128, fit_batch=128, fit_steps=4,
                                   flash=dict(B=1, H=12, T=8192, D=64,
@@ -203,6 +205,8 @@ def _scale(on_tpu):
                              slo_threshold_ms=2000.0, slo_target=0.95),
         "reshard": dict(features=16, hidden=32, classes=4, steps=2,
                         replicas=2),
+        "ckpt_lineage": dict(features=32, hidden=256, classes=8, steps=2,
+                             saves=3),
         "compile_cache": dict(features=16, classes=4, batch_limit=8,
                               max_rows=32, fit_batch=32, fit_steps=2,
                               flash=dict(B=1, H=2, T=128, D=16, trials=1)),
@@ -1372,16 +1376,23 @@ def _baseline_ratio(backend, value, config):
 # ------------------------------------------------------------------- reshard
 
 
-def _chunked_ckpt_write(ckdir, state, fsdp, n_files, iteration=1):
-    """Write a checkpoint in TrainingCheckpointer's on-disk format AS IF an
-    ``fsdp=<fsdp>`` gang of ``n_files`` processes had saved it: each leaf is
-    tiled into fsdp contiguous dim-0 chunks (where divisible) and the chunks
-    are distributed round-robin over the shard files. Lets the bench measure
-    a 4-rank-source restore on whatever devices this process actually has."""
-    # the REAL path-syntax walker: a local copy would silently drift from
-    # the on-disk format the restore actually reads
-    from deeplearning4j_tpu.serde.checkpoint import _leaf_paths
+def _chunked_ckpt_write(lineage_dir, state, fsdp, n_files, iteration=1):
+    """Write a COMMITTED lineage generation in TrainingCheckpointer's
+    on-disk format AS IF an ``fsdp=<fsdp>`` gang of ``n_files`` processes
+    had saved it: each leaf is tiled into fsdp contiguous dim-0 chunks
+    (where divisible), the chunks are distributed round-robin over the
+    shard files, and the full ISSUE 15 commit record lands — per-rank
+    checksummed manifests, self-checksummed meta, COMMIT marker, pointer.
+    Lets the bench measure a 4-rank-source restore (which now VERIFIES the
+    generation first) on whatever devices this process actually has."""
+    # the REAL path-syntax walker + checksum helpers: local copies would
+    # silently drift from the on-disk format the restore actually reads
+    from deeplearning4j_tpu.serde.checkpoint import (_array_crc, _gen_name,
+                                                     _leaf_paths,
+                                                     _self_checksummed)
 
+    gen = _gen_name(iteration)
+    ckdir = os.path.join(lineage_dir, gen)
     os.makedirs(ckdir, exist_ok=True)
     blobs = [{"__save_id__": np.asarray(iteration, np.int64)}
              for _ in range(n_files)]
@@ -1404,15 +1415,30 @@ def _chunked_ckpt_write(ckdir, state, fsdp, n_files, iteration=1):
             blob[key] = chunk
             blob[f"{key}|idx"] = np.asarray(idx, np.int64)
             blob[f"{key}|shape"] = np.asarray(list(a.shape), np.int64)
+    layout = {"axes": {"data": 1, "fsdp": fsdp, "tp": 1},
+              "axis_names": ["data", "fsdp", "tp"]}
     for proc, blob in enumerate(blobs):
-        with open(os.path.join(ckdir, f"shard_{proc}.npz"), "wb") as f:
+        shard = f"shard_{proc}.npz"
+        with open(os.path.join(ckdir, shard), "wb") as f:
             np.savez(f, **blob)
+        manifest = _self_checksummed({
+            "save_id": iteration, "proc": proc, "shard": shard,
+            "process_count": n_files, "layout": layout,
+            "entries": {k: _array_crc(v) for k, v in blob.items()},
+            "nbytes": int(sum(int(v.nbytes) for v in blob.values()))})
+        with open(os.path.join(ckdir, f"manifest_{proc}.json"), "w") as f:
+            json.dump(manifest, f)
     meta = {"iteration": iteration, "epoch": 0, "score": None,
-            "process_count": n_files,
-            "mesh_layout": {"axes": {"data": 1, "fsdp": fsdp, "tp": 1},
-                            "axis_names": ["data", "fsdp", "tp"]}}
+            "process_count": n_files, "generation": gen,
+            "mesh_layout": layout}
     with open(os.path.join(ckdir, "train_state.json"), "w") as f:
-        json.dump(meta, f)
+        json.dump(_self_checksummed(meta), f)
+    with open(os.path.join(ckdir, "COMMIT"), "w") as f:
+        json.dump({"generation": gen, "iteration": iteration,
+                   "process_count": n_files}, f)
+    with open(os.path.join(lineage_dir, "LATEST"), "w") as f:
+        f.write(gen + "\n")
+    return ckdir
 
 
 def _swap_replica():
@@ -1557,6 +1583,154 @@ def bench_reshard(p):
         finally:
             pool.stop()
         out["swap"] = swap
+    return out
+
+
+# ------------------------------------------------------- checkpoint lineage
+
+
+def bench_ckpt_lineage(p):
+    """ISSUE 15: the price of durability, itemized.
+
+    - ``commit_ms`` vs ``inplace_ms``: a full generational save (shard +
+      checksummed manifest + meta + fsync discipline + COMMIT + pointer
+      swap) against the pre-lineage strawman (one npz + one rename, no
+      verify record, no fsync) — the two-phase-commit overhead in absolute
+      terms;
+    - ``nofsync_ms``: the same generational save with ``durable=False`` —
+      isolates the fsync share of the overhead from the manifest share;
+    - ``checksum_mb_per_s``: save-side CRC32 throughput over the real state
+      bytes (the per-array manifest entries);
+    - ``restore_verify_ms`` vs ``restore_noverify_ms`` and
+      ``verify_mb_per_s``: what the pre-restore verification pass costs
+      (price it against the PR 13 ``reshard`` block's restore_ms rows —
+      same state-size ballpark, different axis of work);
+    - ``fallback_restore_ms``: restore latency with the NEWEST generation
+      bit-flipped — verify fail + quarantine + walk back to the previous
+      commit, the unattended self-heal path.
+
+    Runs the real ``tdl_ckpt_*`` counters hot for ``--check-telemetry``
+    (commits, verify failures, quarantines, fallbacks, GC retirements)."""
+    import tempfile
+    import zlib
+
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serde.checkpoint import (TrainingCheckpointer,
+                                                     verify_checkpoint)
+
+    def build_net(seed=0):
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater(Adam(1e-3)).list()
+                .layer(DenseLayer(n_in=p["features"], n_out=p["hidden"],
+                                  activation="relu"))
+                .layer(OutputLayer(n_out=p["classes"], activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, p["features"]).astype(np.float32)
+    Y = np.eye(p["classes"], dtype=np.float32)[
+        rs.randint(0, p["classes"], 32)]
+    net = build_net()
+    for _ in range(p["steps"]):
+        net._fit_batch(DataSet(X, Y))
+    state = {"params": net.params_, "updater": net.updater_state,
+             "bn": net.bn_state}
+    host_leaves = [np.asarray(a) for a in jax.tree.leaves(state)
+                   if hasattr(a, "dtype")]
+    state_bytes = sum(a.nbytes for a in host_leaves)
+    state_mb = state_bytes / (1 << 20)
+
+    out = {"metric": "ckpt_lineage_commit_ms", "unit": "ms",
+           "state_bytes": state_bytes}
+
+    with tempfile.TemporaryDirectory() as d:
+        # (0) save-side checksum throughput, measured directly on the bytes
+        t0 = time.perf_counter()
+        for a in host_leaves:
+            zlib.crc32(np.ascontiguousarray(a).tobytes())
+        crc_s = time.perf_counter() - t0
+        out["checksum_mb_per_s"] = round(state_mb / max(crc_s, 1e-9), 1)
+
+        # (1) full durable generational save — the commit wall
+        ck = TrainingCheckpointer(os.path.join(d, "durable"),
+                                  async_write=False, keep_last=2)
+        walls = []
+        for i in range(p["saves"]):
+            net._fit_batch(DataSet(X, Y))
+            t0 = time.perf_counter()
+            ck.save(net)
+            walls.append((time.perf_counter() - t0) * 1e3)
+        out["commit_ms"] = round(min(walls), 2)  # best-of: page cache warm
+        out["value"] = out["commit_ms"]
+        out["saves"] = p["saves"]
+
+        # (2) same save, fsync off — isolates the durability tax
+        ck_nf = TrainingCheckpointer(os.path.join(d, "nofsync"),
+                                     async_write=False, durable=False)
+        t0 = time.perf_counter()
+        ck_nf.save(net)
+        out["nofsync_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+
+        # (3) the old in-place save strawman: one npz + one rename, no
+        # manifests, no fsync, no commit record — what PR 15 replaced
+        from deeplearning4j_tpu.serde.checkpoint import _leaf_paths
+
+        blob = {}
+        for path, leaf in _leaf_paths(state):
+            if hasattr(leaf, "dtype"):
+                blob[path] = np.asarray(leaf)
+        ip_dir = os.path.join(d, "inplace")
+        os.makedirs(ip_dir)
+        t0 = time.perf_counter()
+        tmp = os.path.join(ip_dir, "shard_0.npz.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **blob)
+        os.replace(tmp, os.path.join(ip_dir, "shard_0.npz"))
+        with open(os.path.join(ip_dir, "train_state.json"), "w") as f:
+            json.dump({"iteration": int(net.iteration)}, f)
+        out["inplace_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        out["commit_overhead_vs_inplace"] = round(
+            out["commit_ms"] / max(out["inplace_ms"], 1e-6), 2)
+
+        # (4) restore: verified vs structural-only
+        fresh = build_net(seed=9)
+        t0 = time.perf_counter()
+        assert ck.restore(fresh)
+        out["restore_verify_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        ck_nv = TrainingCheckpointer(os.path.join(d, "durable"),
+                                     async_write=False,
+                                     verify_on_restore=False)
+        fresh = build_net(seed=10)
+        t0 = time.perf_counter()
+        assert ck_nv.restore(fresh)
+        out["restore_noverify_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        rep = verify_checkpoint(os.path.join(d, "durable"))
+        assert rep["ok"], rep
+        out["verify_ms"] = round(rep["seconds"] * 1e3, 2)
+        out["verify_mb_per_s"] = round(
+            (rep["bytes"] / (1 << 20)) / max(rep["seconds"], 1e-9), 1)
+
+        # (5) fallback latency: bit-flip the newest committed shard (the
+        # SAME corruption primitive the corrupt_ckpt chaos fault injects),
+        # restore walks back one generation (quarantine + older verify)
+        from deeplearning4j_tpu.common.faults import _flip_bit_in_shard
+
+        gendir = ck.committed_generation()
+        assert _flip_bit_in_shard(gendir) is not None
+        fresh = build_net(seed=11)
+        t0 = time.perf_counter()
+        assert ck.restore(fresh)
+        out["fallback_restore_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        out["fallback_quarantined"] = os.path.basename(gendir)
     return out
 
 
@@ -1711,6 +1885,7 @@ BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "serving_slo": bench_serving_slo, "bert_large_fsdp": bench_fsdp,
            "serving_pool": bench_serving_pool,
            "reshard": bench_reshard,
+           "ckpt_lineage": bench_ckpt_lineage,
            "compile_cache": bench_compile_cache}
 
 

@@ -16,17 +16,35 @@ production preemption would.
                                  the observability skew tests inject)
     slow_infer@p=0.05            sleep 0.05s inside every inference batch
     fail_infer@n=5               raise InjectedFault on every 5th inference
+    torn_ckpt@iter=4,stage=shard,rank=0
+                                 hard os._exit INSIDE the checkpoint save at
+                                 one of the two-phase-commit boundaries
+                                 (ISSUE 15): stage= shard (shard tmp bytes
+                                 written, pre-rename) |
+                                 manifest (post-shard, pre-manifest) |
+                                 commit (pre-COMMIT-marker, the default) |
+                                 pointer (pre-pointer-swap)
+    corrupt_ckpt@iter=4,rank=0   bit-flip one shard of the just-COMMITTED
+                                 generation — latent disk corruption the
+                                 restore-side CRC verify must catch
+    enospc@iter=4,rank=0         raise OSError(ENOSPC) at the checkpoint
+                                 write site — the disk-full save failure
 
 The serving faults (ISSUE 5) fire at the ``infer`` site inside
 ``serving.executor.BatchingInferenceExecutor`` — the same machinery a wedged
 or crashing model forward exercises in production — so the serving chaos
-tests drive real admission-control/deadline/shed paths.
+tests drive real admission-control/deadline/shed paths. The checkpoint
+faults (ISSUE 15) fire at the commit-boundary sites inside
+``TrainingCheckpointer.save`` — the kill-matrix chaos tests prove a SIGKILL
+at ANY boundary leaves either the old or the new generation restorable.
 
-``crash``/``hang`` clauses fire only in the gang's FIRST incarnation by
-default (``TDL_GANG_RESTART_COUNT=0``), so a supervisor restart replays the
-faulted iteration cleanly. ``every=1`` makes a clause fire in every
-incarnation (the repeated-crash-at-same-iteration fatal-classification test);
-``restart=N`` pins it to incarnation N.
+``crash``/``hang`` clauses — and the checkpoint faults ``torn_ckpt``/
+``corrupt_ckpt``/``enospc``, which model one-shot disk events — fire only
+in the gang's FIRST incarnation by default (``TDL_GANG_RESTART_COUNT=0``),
+so a supervisor restart replays the faulted iteration cleanly. ``every=1``
+makes a clause fire in every incarnation (the
+repeated-crash-at-same-iteration fatal-classification test); ``restart=N``
+pins it to incarnation N.
 
 Rank defaults come from the launcher's ``TDL_PROCESS_ID`` env so the injector
 never has to import jax; a clause without ``rank=`` fires on every rank.
@@ -55,9 +73,14 @@ class InjectedFault(RuntimeError):
     failure; serving must map it to HTTP 500 like any other model error."""
 
 
+#: checkpoint two-phase-commit boundaries a ``torn_ckpt`` clause can name
+CKPT_STAGES = ("shard", "manifest", "commit", "pointer")
+
+
 @dataclass
 class Fault:
-    kind: str   # "crash" | "hang" | "slow_ckpt_io" | "slow_infer" | "fail_infer"
+    kind: str   # "crash" | "hang" | "slow_ckpt_io" | "slow_infer"
+    #             | "fail_infer" | "torn_ckpt" | "corrupt_ckpt" | "enospc"
     params: Dict[str, str] = field(default_factory=dict)
 
     @property
@@ -102,8 +125,13 @@ def parse_fault_spec(spec: str) -> List[Fault]:
             kind, params = clause, {}
         kind = kind.strip()
         if kind not in ("crash", "hang", "slow_ckpt_io", "slow_infer",
-                        "fail_infer"):
+                        "fail_infer", "torn_ckpt", "corrupt_ckpt", "enospc"):
             raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
+        if kind == "torn_ckpt" and \
+                params.get("stage", "commit") not in CKPT_STAGES:
+            raise ValueError(
+                f"unknown torn_ckpt stage {params.get('stage')!r} in "
+                f"{clause!r}; choose from {CKPT_STAGES}")
         faults.append(Fault(kind, params))
     return faults
 
@@ -114,7 +142,12 @@ class FaultInjector:
     Sites:
 
     - ``train_step`` (iteration=N): ``crash`` / ``hang`` clauses
-    - ``ckpt_write``: ``slow_ckpt_io`` clauses
+    - ``ckpt_write``: ``slow_ckpt_io`` / ``enospc`` clauses
+    - ``ckpt_shard`` / ``ckpt_manifest`` / ``ckpt_commit`` /
+      ``ckpt_pointer``: the two-phase-commit boundaries inside
+      ``TrainingCheckpointer.save`` — ``torn_ckpt`` clauses exit here
+    - ``ckpt_committed`` (path=<generation dir>): fired after a successful
+      commit — ``corrupt_ckpt`` clauses bit-flip a shard here
     - ``infer``: ``slow_infer`` / ``fail_infer`` clauses
     """
 
@@ -152,11 +185,43 @@ class FaultInjector:
         except Exception:  # the black box must never mask the fault itself
             log.exception("flight recorder flush failed during fault injection")
 
-    def fire(self, site: str, iteration: Optional[int] = None) -> None:
+    def fire(self, site: str, iteration: Optional[int] = None,
+             path: Optional[str] = None) -> None:
         if site == "infer":
             self._infer_calls += 1
         for f in self.faults:
-            if site == "train_step" and f.kind in ("crash", "hang"):
+            if site.startswith("ckpt_") and f.kind == "torn_ckpt":
+                # exit at ONE named two-phase-commit boundary: the SIGKILL
+                # kill-matrix (ISSUE 15) — a restorable checkpoint must
+                # survive a death at any of them
+                if site != f"ckpt_{f.params.get('stage', 'commit')}":
+                    continue
+                if not self._matches(f, iteration):
+                    continue
+                self._flight_note(f, iteration)
+                log.warning(
+                    "fault injection: torn_ckpt at %s, iteration %s rank %s "
+                    "(incarnation %s)", site, iteration, self.rank,
+                    self.incarnation)
+                os._exit(CRASH_EXIT_CODE)
+            elif site == "ckpt_write" and f.kind == "enospc":
+                if not self._matches(f, iteration):
+                    continue
+                self._flight_note(f, iteration)
+                import errno
+
+                raise OSError(errno.ENOSPC,
+                              "No space left on device (injected enospc)")
+            elif site == "ckpt_committed" and f.kind == "corrupt_ckpt":
+                if not self._matches(f, iteration) or not path:
+                    continue
+                self._flight_note(f, iteration)
+                flipped = _flip_bit_in_shard(path)
+                log.warning(
+                    "fault injection: corrupt_ckpt bit-flipped %s "
+                    "(iteration %s, incarnation %s)", flipped, iteration,
+                    self.incarnation)
+            elif site == "train_step" and f.kind in ("crash", "hang"):
                 if not self._matches(f, iteration):
                     continue
                 self._flight_note(f, iteration)
@@ -198,11 +263,35 @@ class FaultInjector:
                             f"(inference call {self._infer_calls})")
 
 
+def _flip_bit_in_shard(gendir: str) -> Optional[str]:
+    """Deterministically flip one byte in the first shard file of a
+    committed generation — latent disk corruption, injected AFTER the
+    commit so the checkpoint looked perfectly healthy when written."""
+    try:
+        shards = sorted(f for f in os.listdir(gendir)
+                        if f.startswith("shard_") and f.endswith(".npz"))
+        if not shards:
+            return None
+        target = os.path.join(gendir, shards[0])
+        size = os.path.getsize(target)
+        with open(target, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        return target
+    except OSError:
+        log.exception("corrupt_ckpt injection could not flip a bit in %s",
+                      gendir)
+        return None
+
+
 _cached: Optional[FaultInjector] = None
 _cached_key: Optional[tuple] = None
 
 
-def fault_point(site: str, iteration: Optional[int] = None) -> None:
+def fault_point(site: str, iteration: Optional[int] = None,
+                path: Optional[str] = None) -> None:
     """Library hook: no-op unless ``TDL_FAULT_SPEC`` is set (one dict lookup
     on the hot path). The injector is rebuilt whenever the env contract
     (spec, rank, incarnation) changes, so in-process tests can flip any of
@@ -216,4 +305,4 @@ def fault_point(site: str, iteration: Optional[int] = None) -> None:
     if _cached is None or key != _cached_key:
         _cached = FaultInjector.from_env()
         _cached_key = key
-    _cached.fire(site, iteration)
+    _cached.fire(site, iteration, path=path)

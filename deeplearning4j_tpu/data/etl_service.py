@@ -346,7 +346,14 @@ class DecodedBatchCache:
                     with open(tmp, "w") as f:
                         json.dump({"key": self.key,
                                    "shape": list(self.shape)}, f)
-                    os.replace(tmp, meta)
+                    # the meta rename is the cache's commit record: a power
+                    # loss after a plain rename could leave a zero-length
+                    # "ready" meta vouching for never-synced memmaps (ISSUE
+                    # 15 fsync-bytes-then-rename-then-fsync-dir discipline,
+                    # all owned by durable_replace)
+                    from ..common.durability import durable_replace
+
+                    durable_replace(tmp, meta, fsync=True)
             finally:
                 os.close(fd)
                 try:

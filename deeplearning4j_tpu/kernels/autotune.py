@@ -158,7 +158,12 @@ class AutotuneTable:
         try:
             with os.fdopen(fd, "w") as f:
                 json.dump(payload, f, indent=1, sort_keys=True)
-            os.replace(tmp, self.path)  # atomic: readers never see a torn file
+            # atomic AND durable: readers never see a torn file, and both
+            # the bytes and the rename are fsynced (ISSUE 15 discipline —
+            # measured winners survive power loss)
+            from ..common.durability import durable_replace
+
+            durable_replace(tmp, self.path, fsync=True)
         except BaseException:
             try:
                 os.unlink(tmp)

@@ -49,6 +49,10 @@ through the PR 7 spool merge)::
     tdl_pool_swap_rollbacks_total           swaps aborted because the new
                                             model failed validation (the old
                                             version kept serving)
+    tdl_pool_swap_rejected_total            swaps refused at PRE-FLIGHT
+                                            (ISSUE 15): the checkpoint failed
+                                            lineage verification before any
+                                            surge replica was spawned
 """
 
 from __future__ import annotations
@@ -138,6 +142,10 @@ def pool_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespace:
             "tdl_pool_swap_rollbacks_total",
             "model swaps rolled back because the new model failed to become "
             "ready (the old version kept serving)"),
+        swap_rejected=r.counter(
+            "tdl_pool_swap_rejected_total",
+            "model swaps refused at pre-flight checkpoint verification — "
+            "no surge replica was spawned, the old fleet never noticed"),
     )
 
 

@@ -38,10 +38,21 @@ Recovery is observable through the PR-1 metrics registry:
 ``tdl_worker_deaths_total{reason}``, ``tdl_gang_restarts_total`` and the
 ``tdl_gang_recovery_seconds`` histogram (failure detection → gang respawned).
 
-What is deliberately NOT survivable: lost/torn checkpoint shard files (the
-checkpointer refuses partial restores rather than resurrecting zeroed
-weights) and any attempt to patch a single rank back into a live gang —
-mid-collective partial state is unrecoverable by construction.
+Torn and corrupt checkpoints are SURVIVABLE (ISSUE 15): the checkpointer's
+generational lineage quarantines an unverifiable generation and falls back
+to the newest one whose checksums hold, so a kill mid-save — or a flipped
+bit discovered at restore — costs the gang a respawn plus the steps since
+the previous commit, not the run. The respawn classifies as an ordinary
+recoverable ``crash``; the worker's ``ckpt_quarantine``/``ckpt_fallback``
+flight events land on the postmortem timeline, and when ``ckpt_dir`` is
+set the postmortem carries a ``checkpoint`` section with the full lineage
+inventory (committed/torn/quarantined generations, pointer).
+
+What is deliberately NOT survivable: any attempt to patch a single rank
+back into a live gang — mid-collective partial state is unrecoverable by
+construction — and a lineage whose every committed generation fails
+verification (restore raises ``CheckpointVerifyError`` rather than
+resurrecting corrupt weights or silently training from scratch).
 """
 
 from __future__ import annotations
@@ -212,6 +223,7 @@ class GangSupervisor:
         same_iteration_fatal: int = 3,
         elastic: bool = False,
         min_processes: int = 1,
+        ckpt_dir: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
     ):
         self.target = target
@@ -244,6 +256,10 @@ class GangSupervisor:
         self.same_iteration_fatal = max(2, same_iteration_fatal)
         self.elastic = elastic
         self.min_processes = max(1, min_processes)
+        #: checkpoint lineage root the workers save/restore under (ISSUE 15)
+        #: — when set, every postmortem carries a ``checkpoint`` section
+        #: with the lineage inventory (committed/torn/quarantined, pointer)
+        self.ckpt_dir = ckpt_dir
         self.registry = registry or get_registry()
         (self._deaths, self._restarts_ctr, self._recovery_hist,
          self._last_failure_info) = _supervisor_metrics(self.registry)
@@ -298,7 +314,9 @@ class GangSupervisor:
                 failed_at = None
             failure = self._monitor(procs, hb_dir, attempt, deadline)
             if failure is None:
-                return self._collect(procs)
+                results = self._collect(procs)
+                self._note_recovery_postmortem()
+                return results
             self.events.append(failure)
             self._deaths.labels(failure.reason).inc(len(failure.ranks))
             self._note_failure(failure)
@@ -543,14 +561,36 @@ class GangSupervisor:
             str(failure.iteration) if failure.iteration is not None else "",
         ).set(self.restarts)
 
+    def _note_recovery_postmortem(self) -> None:
+        """After a successful completion that needed ≥1 restart: if the
+        final incarnation's flight spools carry checkpoint quarantine /
+        fallback events (ISSUE 15 — the workers healed a torn or corrupt
+        checkpoint on their way back up), re-write the postmortem with
+        ``classification: "recovered"`` so the on-disk record shows HOW the
+        gang healed: which generation was quarantined, which one restore
+        fell back to, and (with ``ckpt_dir`` set) the final lineage state.
+        Ordinary recoveries keep the failure-time postmortem untouched."""
+        if not self.events:
+            return
+        flight_dir = getattr(self, "flight_dir", None)
+        spools = flight.read_spools(flight_dir) if flight_dir else []
+        if not any(e.get("kind") in ("ckpt_quarantine", "ckpt_fallback")
+                   for e in flight.merge_events(spools, [])):
+            return
+        self._write_postmortem(self.events[-1], classification="recovered",
+                               spools=spools)
+
     def _write_postmortem(self, failure: GangEvent,
-                          classification: Optional[str] = None) -> str:
+                          classification: Optional[str] = None,
+                          spools: Optional[list] = None) -> str:
         """Merge every rank's flight-recorder spool (plus the supervisor's
         own ring) into ONE monotonic-clock-ordered ``postmortem.json`` so an
         unattended failure is debuggable after the fact. Overwritten on each
-        failure — the file always describes the most recent one."""
-        flight_dir = getattr(self, "flight_dir", None)
-        spools = flight.read_spools(flight_dir) if flight_dir else []
+        failure — the file always describes the most recent one. ``spools``
+        lets a caller that already read them skip the second disk pass."""
+        if spools is None:
+            flight_dir = getattr(self, "flight_dir", None)
+            spools = flight.read_spools(flight_dir) if flight_dir else []
         events = flight.merge_events(spools, self._flight.events())
         doc = {
             "classification": classification or failure.reason,
@@ -577,6 +617,16 @@ class GangSupervisor:
             "gang_size": self.n_processes,
             "events": events,
         }
+        if self.ckpt_dir:
+            # checkpoint lineage inventory (ISSUE 15): a fallback respawn's
+            # postmortem must SHOW the quarantined generation and where the
+            # pointer stood, not make the reader diff the filesystem
+            from ..serde.checkpoint import lineage_state
+
+            try:
+                doc["checkpoint"] = lineage_state(self.ckpt_dir)
+            except Exception as e:  # inventory is evidence, never a new crash
+                doc["checkpoint"] = {"error": str(e)}
         tmp = self.postmortem_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1)

@@ -350,11 +350,26 @@ class ServingPool:
 
     def swap_model(self, ckpt: Optional[str] = None, *,
                    env: Optional[Dict[str, str]] = None,
-                   ready_timeout: Optional[float] = None) -> dict:
+                   ready_timeout: Optional[float] = None,
+                   preflight_verify: bool = True) -> dict:
         """Roll every replica onto a new model version with zero downtime.
 
         ``ckpt`` lands in the replicas' env as ``TDL_MODEL_CKPT`` (targets
         read it at build time); ``env`` passes arbitrary extra version env.
+        ``ckpt`` is PRE-FLIGHT VERIFIED (ISSUE 15): when the path is a
+        recognizable ``TrainingCheckpointer`` lineage (or legacy flat
+        checkpoint), the newest committed generation's manifests and
+        per-array checksums are checked BEFORE the first surge replica is
+        spawned, so a torn or bit-flipped artifact is rejected
+        (``ValueError``, ``tdl_pool_swap_rejected_total``,
+        ``pool_swap_rejected`` flight event) with the old fleet never
+        touched and zero traffic risk — strictly cheaper than discovering
+        it through a surge replica that never probes ready. Paths that are
+        not checkpoint lineages (targets may interpret ``TDL_MODEL_CKPT``
+        however they like) pass through to the surge-replica readiness
+        validation, which remains the universal gate.
+        ``preflight_verify=False`` skips the check entirely (e.g. a
+        checkpoint on a filesystem the pool process cannot read).
         Surge-style roll, one replica at a time:
 
         1. spawn ONE extra replica on the new version (it warms from the
@@ -379,6 +394,25 @@ class ServingPool:
             overrides[ENV_MODEL_CKPT] = str(ckpt)
         if not overrides:
             raise ValueError("swap_model needs a checkpoint path or env")
+        if ckpt is not None and preflight_verify:
+            from ..serde.checkpoint import verify_checkpoint
+
+            report = verify_checkpoint(str(ckpt))
+            # reason "no_checkpoint" = the path is not a recognizable
+            # TrainingCheckpointer lineage at all (targets may interpret
+            # TDL_MODEL_CKPT however they like — a config file, a zip);
+            # such artifacts pass through to the surge-replica validation,
+            # which remains the universal gate
+            if not report["ok"] and report["reason"] != "no_checkpoint":
+                self._m.swap_rejected.inc()
+                flight.record("pool_swap_rejected", model=str(ckpt),
+                              reason=report["reason"],
+                              generation=report.get("generation"))
+                raise ValueError(
+                    f"swap_model rejected checkpoint {ckpt}: verification "
+                    f"failed ({report['reason']}, generation "
+                    f"{report.get('generation')}) — no surge replica was "
+                    "spawned, the serving fleet is untouched")
         if not self._swap_lock.acquire(blocking=False):
             raise RuntimeError("a model swap is already in progress")
         t0 = time.perf_counter()

@@ -1,18 +1,34 @@
-"""Checkpoint depth (SURVEY §5.4): sharded save/restore, async write,
-iterator-position capture, preemption hook, resume-equals-uninterrupted."""
+"""Checkpoint depth (SURVEY §5.4) + durable lineage (ISSUE 15): sharded
+save/restore, async write, iterator-position capture, preemption hook,
+resume-equals-uninterrupted — and the crash-consistent generational story:
+two-phase commit, verify-then-fallback restore with quarantine,
+transactional restore, keep-last-K GC, and the fsync AST lint."""
 
+import ast
+import json
 import os
+import pathlib
 import signal
+import zlib
 
 import numpy as np
 import pytest
 
+from deeplearning4j_tpu.common import faults
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.monitoring.registry import get_registry
 from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
 from deeplearning4j_tpu.nn.updaters import Adam
-from deeplearning4j_tpu.serde.checkpoint import PreemptionHandler, TrainingCheckpointer
+from deeplearning4j_tpu.serde.checkpoint import (CheckpointVerifyError,
+                                                 PreemptionHandler,
+                                                 TrainingCheckpointer,
+                                                 _gen_name, _self_checksummed,
+                                                 lineage_state,
+                                                 verify_checkpoint)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent / "deeplearning4j_tpu"
 
 
 def _net(seed=5):
@@ -34,6 +50,42 @@ def _data(n=64, seed=0):
     x = rs.randn(n, 4).astype(np.float32)
     y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
     return x, y
+
+
+def _fit_steps(net, steps, x, y, batch=8):
+    for i in range(steps):
+        lo = (i * batch) % (len(x) - batch)
+        net._fit_batch(DataSet(x[lo:lo + batch], y[lo:lo + batch]))
+
+
+def _flip_byte(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _state_bytes(net):
+    """Bit-exact snapshot of every param/updater/bn leaf + counters."""
+    import jax
+
+    leaves = (jax.tree.leaves(net.params_) + jax.tree.leaves(net.updater_state)
+              + jax.tree.leaves(net.bn_state))
+    return ([np.asarray(a).tobytes() for a in leaves],
+            int(net.iteration), int(net.epoch))
+
+
+def _counter_value(name, *label_vals):
+    snap = get_registry().snapshot().get(name)
+    if not snap:
+        return 0.0
+    total = 0.0
+    for s in snap["series"]:
+        if not label_vals or list(s["labels"].values()) == list(label_vals):
+            total += s["value"]
+    return total
 
 
 class TestCheckpointer:
@@ -64,10 +116,13 @@ class TestCheckpointer:
     def test_async_write_is_durable_after_wait(self, tmp_path):
         net = _net()
         ck = TrainingCheckpointer(str(tmp_path), async_write=True)
-        ck.save(net)
+        gendir = ck.save(net)
         ck.wait()
-        assert os.path.exists(tmp_path / "latest" / "train_state.json")
-        assert os.path.exists(tmp_path / "latest" / "shard_0.npz")
+        assert os.path.exists(os.path.join(gendir, "train_state.json"))
+        assert os.path.exists(os.path.join(gendir, "shard_0.npz"))
+        assert os.path.exists(os.path.join(gendir, "manifest_0.json"))
+        assert os.path.exists(os.path.join(gendir, "COMMIT"))
+        assert ck.committed_generation() == gendir
 
     def test_kill_at_step_k_resume_reproduces_loss_curve(self, tmp_path):
         """The §5.4 'done' bar: checkpoint at step k, restore into a FRESH
@@ -107,8 +162,6 @@ class TestCheckpointer:
         tdl_checkpoint_failures_total."""
         import numpy as _np
 
-        from deeplearning4j_tpu.monitoring.registry import get_registry
-
         failures = get_registry().counter("tdl_checkpoint_failures_total")
         before = failures.value
         net = _net()
@@ -127,9 +180,10 @@ class TestCheckpointer:
 
         # the error is consumed once surfaced; a healthy save works again
         monkeypatch.setattr(_np, "savez", real_savez)
-        ck.save(net)
+        gendir = ck.save(net)
         ck.wait()
-        assert os.path.exists(tmp_path / "latest" / "shard_0.npz")
+        assert os.path.exists(os.path.join(gendir, "shard_0.npz"))
+        assert ck.committed_generation() == gendir
 
     def test_async_write_failure_reraised_by_next_save(self, tmp_path, monkeypatch):
         import numpy as _np
@@ -145,13 +199,10 @@ class TestCheckpointer:
     def test_sharded_arrays_roundtrip_over_mesh(self, tmp_path):
         """Params sharded over the 8-device mesh save shard-wise and
         reassemble to the same global values."""
-        import jax
         from deeplearning4j_tpu.parallel.mesh import build_mesh
         from deeplearning4j_tpu.parallel.sharding import alternating_dense_rules
         from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
-        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
 
-        x, y = _data(32)
         net = _net()
         before = {k: {p: np.asarray(v) for p, v in d.items()}
                   for k, d in net.params_.items()}
@@ -168,6 +219,580 @@ class TestCheckpointer:
                     np.asarray(net2.params_[k][p]), before[k][p], rtol=1e-6)
 
 
+# ------------------------------------------------ durable lineage (ISSUE 15)
+
+
+class TestLineage:
+    def test_generational_saves_never_mutate_and_pointer_tracks_newest(
+            self, tmp_path):
+        net = _net()
+        x, y = _data()
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        _fit_steps(net, 2, x, y)
+        gen_a = ck.save(net)
+        a_bytes = open(os.path.join(gen_a, "shard_0.npz"), "rb").read()
+        _fit_steps(net, 2, x, y)
+        gen_b = ck.save(net)
+        assert gen_a != gen_b
+        # the older generation was not touched by the newer save
+        assert open(os.path.join(gen_a, "shard_0.npz"), "rb").read() == a_bytes
+        with open(tmp_path / "latest" / "LATEST") as f:
+            assert f.read().strip() == os.path.basename(gen_b)
+        assert ck.committed_generation() == gen_b
+        st = lineage_state(str(tmp_path))
+        assert [g["generation"] for g in st["committed"]] == \
+            [os.path.basename(gen_a), os.path.basename(gen_b)]
+        assert st["pointer"] == os.path.basename(gen_b)
+        assert st["quarantined"] == [] and st["uncommitted"] == []
+
+    def test_gc_keeps_last_k_and_never_the_newest_committed(self, tmp_path):
+        net = _net()
+        x, y = _data()
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False,
+                                  keep_last=2)
+        gens = []
+        for _ in range(5):
+            _fit_steps(net, 1, x, y)
+            gens.append(os.path.basename(ck.save(net)))
+        live = sorted(n for n in os.listdir(tmp_path / "latest")
+                      if n.startswith("gen-"))
+        assert live == gens[-2:]
+        assert ck.committed_generation().endswith(gens[-1])
+        # keep_last=1 (clamped floor): even then the newest survives every GC
+        ck1 = TrainingCheckpointer(str(tmp_path), async_write=False,
+                                   keep_last=1)
+        for _ in range(3):
+            _fit_steps(net, 1, x, y)
+            newest = ck1.save(net)
+            assert os.path.isdir(newest)
+            fresh = _net(seed=31)
+            assert ck1.restore(fresh)
+            assert fresh.iteration == net.iteration
+
+    def test_concurrent_async_save_gc_never_breaks_restore(self, tmp_path):
+        """keep_last=1 with ASYNC saves: GC runs on the writer thread while
+        the train loop keeps fitting — after every wait() the lineage must
+        hold a restorable newest generation (GC never eats the generation
+        being written or the one just committed)."""
+        net = _net()
+        x, y = _data()
+        ck = TrainingCheckpointer(str(tmp_path), async_write=True,
+                                  keep_last=1)
+        for _ in range(4):
+            _fit_steps(net, 1, x, y)
+            ck.save(net)
+        ck.wait()
+        fresh = _net(seed=8)
+        assert ck.restore(fresh)
+        assert fresh.iteration == net.iteration
+
+    def test_resave_at_same_iteration_never_mutates_committed(self, tmp_path):
+        """Review fix pin: a re-save at an UNCHANGED iteration counter (the
+        PBT clone/re-save shape) lands in a suffixed sibling generation —
+        the committed bytes are never rewritten in place, and the suffixed
+        sibling is the newer one by ordering."""
+        net = _net()
+        x, y = _data()
+        _fit_steps(net, 2, x, y)
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        g1 = ck.save(net)
+        bytes1 = open(os.path.join(g1, "shard_0.npz"), "rb").read()
+
+        clone = _net(seed=99)            # different weights...
+        clone.iteration = net.iteration  # ...same iteration counter
+        clone.epoch = net.epoch
+        g2 = ck.save(clone)
+        assert g2 == g1 + "a", (g1, g2)
+        assert open(os.path.join(g1, "shard_0.npz"), "rb").read() == bytes1
+        assert ck.committed_generation() == g2  # suffix orders newest-last
+        fresh = _net(seed=3)
+        assert ck.restore(fresh)         # the clone's weights win
+        np.testing.assert_array_equal(
+            np.asarray(fresh.params_["0"]["W"]),
+            np.asarray(clone.params_["0"]["W"]))
+        assert verify_checkpoint(str(tmp_path))["generation"] == \
+            os.path.basename(g2)
+
+        # async form of the pin: the name probe runs AFTER the in-flight
+        # background write commits (save waits first), so back-to-back
+        # async saves at one iteration land in DISTINCT suffixed siblings
+        # instead of the second mutating the first's just-committed dir
+        ck_async = TrainingCheckpointer(str(tmp_path), async_write=True)
+        g3 = g4 = None
+        for seed in (55, 56):
+            c = _net(seed=seed)
+            c.iteration, c.epoch = net.iteration, net.epoch
+            g3, g4 = g4, ck_async.save(c)
+        ck_async.wait()
+        assert g3 == g1 + "b" and g4 == g1 + "c", (g3, g4)
+        assert os.path.exists(os.path.join(g3, "COMMIT"))
+        assert os.path.exists(os.path.join(g4, "COMMIT"))
+
+    def test_restore_is_transactional_on_verify_failure(self, tmp_path):
+        """ISSUE 15 pin: when NOTHING verifies, restore raises and leaves
+        params, updater state, net.iteration and the ITERATOR position
+        bit-identical to the pre-call state."""
+        x, y = _data(64)
+        net = _net()
+        it = ArrayDataSetIterator(x, y, 8, shuffle=True, seed=3)
+        for _ in range(2):
+            net._fit_batch(it.next())
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        ck.save(net, iterator=it)
+        # corrupt EVERY committed generation (there is exactly one)
+        for name in os.listdir(tmp_path / "latest"):
+            if name.startswith("gen-"):
+                _flip_byte(tmp_path / "latest" / name / "shard_0.npz")
+
+        victim = _net(seed=77)
+        it2 = ArrayDataSetIterator(x, y, 8, shuffle=True, seed=9)
+        for _ in range(3):
+            victim._fit_batch(it2.next())
+        leaves0, iter0, epoch0 = _state_bytes(victim)
+        it_state0 = json.dumps(it2.state())
+        with pytest.raises(CheckpointVerifyError, match="nothing restorable"):
+            ck.restore(victim, iterator=it2)
+        leaves1, iter1, epoch1 = _state_bytes(victim)
+        assert leaves0 == leaves1          # bit-identical state trees
+        assert (iter0, epoch0) == (iter1, epoch1)
+        assert json.dumps(it2.state()) == it_state0
+        # the failing generation is quarantined, not left as poison
+        assert any(".corrupt" in n for n in os.listdir(tmp_path / "latest"))
+        # ...and the all-corrupt verdict is STICKY: the next restore (the
+        # respawned incarnation) must raise again off the pointer/COMMIT
+        # evidence, never silently fresh-init over lost progress
+        with pytest.raises(CheckpointVerifyError, match="demonstrably"):
+            ck.restore(_net(seed=78))
+
+    def test_fallback_restores_newest_verifiable_generation(self, tmp_path):
+        net = _net()
+        x, y = _data()
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        _fit_steps(net, 2, x, y)
+        gen_a = ck.save(net)
+        import jax
+
+        params_a = [np.asarray(w) for w in jax.tree.leaves(net.params_)]
+        iter_a = int(net.iteration)
+        _fit_steps(net, 2, x, y)
+        gen_b = ck.save(net)
+        _flip_byte(os.path.join(gen_b, "shard_0.npz"))
+
+        fails0 = _counter_value("tdl_ckpt_verify_failures_total")
+        quar0 = _counter_value("tdl_ckpt_quarantined_total")
+        fb0 = _counter_value("tdl_ckpt_fallback_restores_total")
+        fresh = _net(seed=42)
+        assert ck.restore(fresh)
+        assert int(fresh.iteration) == iter_a
+        for got, want in zip(jax.tree.leaves(fresh.params_), params_a):
+            np.testing.assert_array_equal(np.asarray(got), want)
+        assert _counter_value("tdl_ckpt_verify_failures_total") == fails0 + 1
+        assert _counter_value("tdl_ckpt_quarantined_total") == quar0 + 1
+        assert _counter_value("tdl_ckpt_fallback_restores_total") == fb0 + 1
+        # quarantined under a *.corrupt name; gen_a still the committed tip
+        assert not os.path.exists(gen_b)
+        assert os.path.isdir(gen_b + ".corrupt")
+        assert ck.committed_generation() == gen_a
+        # a quarantined dir handed back to the pre-flight is NEVER blessed
+        # (its basename no longer parses as a generation — without the
+        # explicit check it would sniff as a "legacy" flat checkpoint)
+        rep = verify_checkpoint(gen_b + ".corrupt")
+        assert not rep["ok"] and rep["reason"] == "quarantined", rep
+        # the freed name is reusable: training on and re-saving works
+        _fit_steps(fresh, 4, x, y)
+        ck.save(fresh)
+        assert ck.restore(_net(seed=43))
+
+    def test_kill_matrix_boundaries_single_process(self, tmp_path):
+        """Fast tier mirror of the chaos kill-matrix: hand-build the exact
+        on-disk states a SIGKILL leaves at each commit boundary and pin
+        which generation restores. (The real-process version rides
+        tests/test_supervisor.py's slow tier.)"""
+        net = _net()
+        x, y = _data()
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        _fit_steps(net, 2, x, y)
+        gen_a = ck.save(net)
+        iter_a = int(net.iteration)
+        _fit_steps(net, 2, x, y)
+        gen_b = ck.save(net)
+        iter_b = int(net.iteration)
+        lineage = tmp_path / "latest"
+        import shutil
+
+        pristine = tmp_path / "pristine_gen_b"
+        shutil.copytree(gen_b, pristine)
+
+        def reset_gen_b(tamper):
+            """Fresh copy of the committed gen_b, then one boundary tamper
+            (the state a SIGKILL at that boundary leaves behind)."""
+            if os.path.isdir(gen_b):
+                shutil.rmtree(gen_b)
+            shutil.copytree(pristine, gen_b)
+            with open(lineage / "LATEST", "w") as f:
+                f.write(os.path.basename(gen_b) + "\n")
+            tamper()
+
+        def restore_iteration():
+            fresh = _net(seed=9)
+            assert ck.restore(fresh)
+            return int(fresh.iteration)
+
+        # pre-pointer-swap: COMMIT exists, pointer still names gen_a —
+        # iteration order wins and the NEW generation restores
+        reset_gen_b(lambda: open(lineage / "LATEST", "w").write(
+            os.path.basename(gen_a) + "\n"))
+        assert restore_iteration() == iter_b
+
+        # pre-COMMIT: marker missing → uncommitted → quarantine + fallback
+        reset_gen_b(lambda: os.unlink(os.path.join(gen_b, "COMMIT")))
+        assert restore_iteration() == iter_a
+        assert os.path.isdir(gen_b + ".corrupt")
+
+        # post-shard / pre-manifest: COMMIT present but no rank manifest
+        reset_gen_b(lambda: os.unlink(os.path.join(gen_b, "manifest_0.json")))
+        assert restore_iteration() == iter_a
+
+        # mid-shard: a torn (truncated) shard fails its manifest CRCs
+        def truncate_shard():
+            shard = os.path.join(gen_b, "shard_0.npz")
+            with open(shard, "r+b") as f:
+                f.truncate(os.path.getsize(shard) // 2)
+
+        reset_gen_b(truncate_shard)
+        assert restore_iteration() == iter_a
+
+    def test_uncommitted_only_lineage_is_no_checkpoint_not_silent(
+            self, tmp_path):
+        """Nothing was ever committed (first save torn): restore answers
+        False — truthfully, no save() ever completed — but LOUDLY: the torn
+        generation is quarantined and counted, never restored from."""
+        lineage = tmp_path / "latest"
+        gen = lineage / _gen_name(2)
+        gen.mkdir(parents=True)
+        (gen / "shard_0.npz").write_bytes(b"torn")
+        quar0 = _counter_value("tdl_ckpt_quarantined_total")
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        assert not ck.restore(_net())
+        assert _counter_value("tdl_ckpt_quarantined_total") == quar0 + 1
+        assert any(n.endswith(".corrupt") for n in os.listdir(lineage))
+        # never-committed evidence stays "no checkpoint" on every later
+        # call too (no pointer, no COMMIT marker = no commit was ever lost)
+        assert not ck.restore(_net())
+        # ...but once a commit EXISTED, an unverifiable lineage must raise
+        net = _net()
+        x, y = _data()
+        _fit_steps(net, 1, x, y)
+        gendir = ck.save(net)
+        _flip_byte(os.path.join(gendir, "shard_0.npz"))
+        with pytest.raises(CheckpointVerifyError):
+            ck.restore(_net(seed=2))
+
+    def test_legacy_torn_dir_raises_instead_of_fresh_init(self, tmp_path):
+        """ISSUE 15 satellite bugfix: a PRE-LINEAGE dir holding shard files
+        but no train_state.json (rank-0 killed between shard and meta
+        writes) used to return False — the next incarnation silently
+        trained from scratch. Now it raises."""
+        legacy = tmp_path / "latest"
+        legacy.mkdir()
+        (legacy / "shard_0.npz").write_bytes(b"not-a-real-npz")
+        with pytest.raises(CheckpointVerifyError, match="torn"):
+            TrainingCheckpointer(str(tmp_path)).restore(_net())
+
+    def test_manifest_save_id_and_checksum_tampering_detected(self, tmp_path):
+        net = _net()
+        x, y = _data()
+        _fit_steps(net, 2, x, y)
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        gendir = ck.save(net)
+        man_path = os.path.join(gendir, "manifest_0.json")
+        with open(man_path) as f:
+            man = json.load(f)
+
+        # (a) flipped save_id with a RE-STAMPED checksum → reason save_id
+        bad = dict(man)
+        bad["save_id"] = man["save_id"] + 1
+        with open(man_path, "w") as f:
+            json.dump(_self_checksummed(bad), f)
+        rep = verify_checkpoint(str(tmp_path))
+        assert not rep["ok"] and rep["reason"] == "save_id"
+
+        # (b) edited body WITHOUT re-stamping → self-checksum catches it
+        bad2 = dict(man)
+        bad2["entries"] = dict(man["entries"], **{"__save_id__": 1})
+        with open(man_path, "w") as f:
+            json.dump(bad2, f)
+        rep = verify_checkpoint(str(tmp_path))
+        assert not rep["ok"] and rep["reason"] == "manifest_crc"
+
+        # restore agrees with the pre-flight verdict: quarantine + raise
+        with pytest.raises(CheckpointVerifyError):
+            ck.restore(_net(seed=3))
+
+    def test_legacy_flat_never_shadows_generations(self, tmp_path):
+        """Review fix pin: after the lineage upgrade, a leftover pre-lineage
+        flat checkpoint in the same dir must NOT shadow newer committed
+        generations — generations outrank it, and it survives only as the
+        deepest fallback."""
+        import shutil
+
+        net = _net()
+        x, y = _data()
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        _fit_steps(net, 2, x, y)
+        gen_old = ck.save(net)
+        iter_legacy = int(net.iteration)
+        # fabricate the pre-lineage flat layout from that save's artifacts
+        lineage = tmp_path / "latest"
+        shutil.copy(os.path.join(gen_old, "shard_0.npz"),
+                    lineage / "shard_0.npz")
+        shutil.copy(os.path.join(gen_old, "train_state.json"),
+                    lineage / "train_state.json")
+        shutil.rmtree(gen_old)
+        # progress continues post-upgrade: two committed generations on top
+        _fit_steps(net, 2, x, y)
+        ck.save(net)
+        _fit_steps(net, 2, x, y)
+        gen_new = ck.save(net)
+        iter_new = int(net.iteration)
+
+        fresh = _net(seed=21)
+        assert ck.restore(fresh)
+        assert int(fresh.iteration) == iter_new  # generation won, not legacy
+        rep = verify_checkpoint(str(tmp_path))
+        assert rep["ok"] and rep["generation"] == os.path.basename(gen_new)
+        st = lineage_state(str(tmp_path))
+        assert st["legacy_flat"] and st["format"] == "lineage"
+
+        # every generation corrupted → the flat checkpoint is the LAST
+        # fallback instead of a raise (it is still a committed artifact)
+        for name in list(os.listdir(lineage)):
+            if name.startswith("gen-") and not name.endswith(".corrupt"):
+                _flip_byte(lineage / name / "shard_0.npz")
+        fb0 = _counter_value("tdl_ckpt_fallback_restores_total")
+        fresh2 = _net(seed=22)
+        assert ck.restore(fresh2)
+        assert int(fresh2.iteration) == iter_legacy
+        assert _counter_value("tdl_ckpt_fallback_restores_total") == fb0 + 1
+
+    def test_verify_checkpoint_accepts_all_path_shapes(self, tmp_path):
+        """Review fix pin: verify_checkpoint must judge the SAME generation
+        whether handed the checkpointer root, the lineage dir, or the
+        generation dir save() returned — a silent 'no_checkpoint' pass on
+        any of those shapes would let swap_model skip verification."""
+        net = _net()
+        x, y = _data()
+        _fit_steps(net, 2, x, y)
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        gendir = ck.save(net)
+        for path, fmt in ((str(tmp_path), "lineage"),
+                          (str(tmp_path / "latest"), "lineage"),
+                          (gendir, "generation")):
+            rep = verify_checkpoint(path)
+            assert rep["ok"] and rep["format"] == fmt, (path, rep)
+            assert rep["generation"] == os.path.basename(gendir)
+        _flip_byte(os.path.join(gendir, "shard_0.npz"))
+        for path in (str(tmp_path), str(tmp_path / "latest"), gendir):
+            rep = verify_checkpoint(path)
+            assert not rep["ok"] and rep["reason"] == "shard_crc", (path, rep)
+
+    def test_commit_scope_mismatch_is_a_verify_failure(self, tmp_path):
+        """Review fix pin: a manifest with the right save id but a DIFFERENT
+        gang shape (a torn same-iteration leftover from before a resize)
+        must fail verification — committing or restoring it would mix two
+        topologies in one generation."""
+        net = _net()
+        x, y = _data()
+        _fit_steps(net, 2, x, y)
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        gendir = ck.save(net)
+        man_path = os.path.join(gendir, "manifest_0.json")
+        with open(man_path) as f:
+            man = json.load(f)
+        man["process_count"] = 4  # the old, bigger gang's scope
+        with open(man_path, "w") as f:
+            json.dump(_self_checksummed(man), f)
+        rep = verify_checkpoint(str(tmp_path))
+        assert not rep["ok"] and rep["reason"] == "scope", rep
+
+    def test_verify_checkpoint_api(self, tmp_path):
+        rep = verify_checkpoint(str(tmp_path))
+        assert not rep["ok"] and rep["reason"] == "no_checkpoint"
+        net = _net()
+        x, y = _data()
+        _fit_steps(net, 2, x, y)
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        gendir = ck.save(net)
+        rep = verify_checkpoint(str(tmp_path))
+        assert rep["ok"] and rep["format"] == "lineage"
+        assert rep["generation"] == os.path.basename(gendir)
+        assert rep["iteration"] == int(net.iteration)
+        assert rep["bytes"] > 0
+        # a corrupt NEWEST generation fails pre-flight even though restore
+        # could fall back — swap_model must not silently ship an older model
+        _fit_steps(net, 1, x, y)
+        gen_b = ck.save(net)
+        _flip_byte(os.path.join(gen_b, "shard_0.npz"))
+        rep = verify_checkpoint(str(tmp_path))
+        assert not rep["ok"] and rep["reason"] == "shard_crc"
+        # pre-flight never quarantines: restore still sees both generations
+        assert os.path.isdir(gen_b)
+
+
+# ------------------------------------------ checkpoint chaos faults (ISSUE 15)
+
+
+class TestCheckpointFaults:
+    def test_torn_ckpt_spec_parsing_and_stage_validation(self):
+        f = faults.parse_fault_spec("torn_ckpt@iter=4,stage=shard,rank=0")[0]
+        assert f.kind == "torn_ckpt" and f.iteration == 4 and f.rank == 0
+        assert f.params["stage"] == "shard"
+        assert faults.parse_fault_spec("corrupt_ckpt@iter=3")[0].kind == \
+            "corrupt_ckpt"
+        assert faults.parse_fault_spec("enospc@iter=2,rank=1")[0].kind == \
+            "enospc"
+        with pytest.raises(ValueError, match="torn_ckpt stage"):
+            faults.parse_fault_spec("torn_ckpt@iter=4,stage=nope")
+        # default stage is the pre-COMMIT boundary
+        f = faults.parse_fault_spec("torn_ckpt@iter=4")[0]
+        inj = faults.FaultInjector([f], rank=0, incarnation=1)
+        inj.fire("ckpt_commit", iteration=4)  # wrong incarnation: no exit
+
+    def test_enospc_fault_fails_save_and_generation_stays_uncommitted(
+            self, tmp_path, monkeypatch):
+        net = _net()
+        x, y = _data()
+        _fit_steps(net, 2, x, y)
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        monkeypatch.setenv(faults.ENV_SPEC,
+                           f"enospc@iter={int(net.iteration)}")
+        with pytest.raises(OSError, match="No space left"):
+            ck.save(net)
+        assert ck.committed_generation() is None
+        # the failed attempt left no restorable state; next save (fault is
+        # one-shot at that iteration in incarnation 0 only... here the env
+        # clause stays, so clear it) commits into the SAME generation name
+        monkeypatch.delenv(faults.ENV_SPEC)
+        gendir = ck.save(net)
+        assert os.path.exists(os.path.join(gendir, "COMMIT"))
+        assert ck.restore(_net(seed=3))
+
+    def test_corrupt_ckpt_fault_bitflips_committed_shard(self, tmp_path,
+                                                         monkeypatch):
+        net = _net()
+        x, y = _data()
+        _fit_steps(net, 2, x, y)
+        ck = TrainingCheckpointer(str(tmp_path), async_write=False)
+        gen_a = ck.save(net)
+        iter_a = int(net.iteration)
+        _fit_steps(net, 2, x, y)
+        monkeypatch.setenv(faults.ENV_SPEC,
+                           f"corrupt_ckpt@iter={int(net.iteration)}")
+        gen_b = ck.save(net)  # commits, THEN the injector flips a bit
+        monkeypatch.delenv(faults.ENV_SPEC)
+        assert os.path.exists(os.path.join(gen_b, "COMMIT"))
+        rep = verify_checkpoint(str(tmp_path))
+        assert not rep["ok"] and rep["reason"] == "shard_crc"
+        fresh = _net(seed=11)
+        assert ck.restore(fresh)  # quarantine + fallback
+        assert int(fresh.iteration) == iter_a
+        assert os.path.isdir(gen_b + ".corrupt")
+
+
+# ------------------------------------------------------------------ AST lint
+
+
+_DURABILITY_LINT_FILES = ("serde/checkpoint.py", "common/durability.py")
+_SYNC_CALLS = {"fsync", "fsync_dir", "durable_replace", "durable_write_json",
+               "durable_write_bytes"}
+
+
+def _durability_offenders(src: str, rel: str):
+    """``os.replace`` rename-commits without an fsync call earlier in the
+    SAME function (nested functions are their own scope) and without a
+    ``# durability-ok:`` justification on the call line or the line above."""
+    lines = src.splitlines()
+    tree = ast.parse(src, filename=rel)
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    offenders = []
+    for fn in fns:
+        calls = []
+
+        def collect(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue  # nested scope: audited as its own function
+                if isinstance(child, ast.Call):
+                    calls.append(child)
+                collect(child)
+
+        collect(fn)
+        sync_lines = []
+        replaces = []
+        for c in calls:
+            name = None
+            if isinstance(c.func, ast.Attribute):
+                name = c.func.attr
+            elif isinstance(c.func, ast.Name):
+                name = c.func.id
+            if name in _SYNC_CALLS:
+                sync_lines.append(c.lineno)
+            elif name == "replace" and isinstance(c.func, ast.Attribute) \
+                    and isinstance(c.func.value, ast.Name) \
+                    and c.func.value.id == "os":
+                replaces.append(c.lineno)
+        for lineno in replaces:
+            context = lines[max(0, lineno - 2):lineno]
+            if any("durability-ok" in ln for ln in context):
+                continue
+            if not any(s < lineno for s in sync_lines):
+                offenders.append(f"{rel}:{lineno} ({fn.name})")
+    return offenders
+
+
+def test_checkpoint_writes_are_durable():
+    """ISSUE 15 satellite (repo lint): every open-for-write + ``os.replace``
+    commit in the checkpoint writers must fsync in between — a host power
+    loss after an unfsynced rename leaves a zero-length "committed" file.
+    Escape hatch: ``# durability-ok: <reason>`` on the call line or the
+    line above it."""
+    offenders = []
+    for rel in _DURABILITY_LINT_FILES:
+        offenders += _durability_offenders((ROOT / rel).read_text(), rel)
+    assert not offenders, (
+        "rename-commit without an fsync before it (power loss can leave a "
+        "zero-length committed file; annotate genuinely-advisory writes "
+        f"with `# durability-ok: <reason>`): {offenders}")
+
+
+def test_durability_lint_catches_a_planted_offender():
+    planted = (
+        "import os\n"
+        "def bad(path):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write('x')\n"
+        "    os.replace(tmp, path)\n"
+        "def good(path):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write('x')\n"
+        "        os.fsync(f.fileno())\n"
+        "    os.replace(tmp, path)\n"
+        "def escaped(path):\n"
+        "    os.replace(path + '.t', path)  # durability-ok: advisory spool\n"
+        "def nested(path):\n"
+        "    os.fsync(0)\n"
+        "    def inner():\n"
+        "        os.replace(path + '.t', path)\n"  # no fsync in ITS scope
+        "    inner()\n"
+    )
+    hits = _durability_offenders(planted, "planted.py")
+    assert hits == ["planted.py:6 (bad)", "planted.py:18 (inner)"], hits
+
+
 class TestPreemption:
     def test_sigterm_saves_before_death(self, tmp_path):
         net = _net()
@@ -180,7 +805,10 @@ class TestPreemption:
         finally:
             h.uninstall()
         assert h.fired
-        assert os.path.exists(tmp_path / "preempt" / "train_state.json")
+        gendir = ck.committed_generation(tag="preempt")
+        assert gendir and os.path.exists(os.path.join(gendir,
+                                                      "train_state.json"))
+        assert verify_checkpoint(str(tmp_path), tag="preempt")["ok"]
         net2 = _net(seed=42)
         assert ck.restore(net2, tag="preempt")
         np.testing.assert_array_equal(

@@ -15,6 +15,7 @@ tests/test_multiprocess.py::test_cross_topology_gang_restore_parity.
 """
 
 import ast
+import os
 import pathlib
 import re
 
@@ -215,23 +216,33 @@ def test_fill_from_chunks_verifies_tiling_coverage():
 
 
 def test_save_cleans_stale_shards_from_a_bigger_gang(tmp_path):
-    """ISSUE 14 aftermath hygiene: a smaller (post-resize) gang's save into
-    the same tag must remove the dead ranks' stale shard files — otherwise
-    the NEXT restore globs them, fails the save-id check, and a healthy
-    checkpoint reads as torn (the post-resize gang could never recover)."""
+    """ISSUE 14 aftermath hygiene, lineage form (ISSUE 15): a smaller
+    (post-resize) gang re-saving an iteration whose generation dir holds a
+    bigger gang's TORN leftovers must not commit the dead ranks' stale
+    shard/manifest files into the generation — the next verify would fail
+    the save-id/manifest checks and a healthy checkpoint would read as
+    corrupt (the post-resize gang could never recover)."""
     import shutil
+
+    from deeplearning4j_tpu.serde.checkpoint import _gen_name
 
     a = _mlp()
     ta = ParallelTrainer(a, mesh_layout=SpecLayout(data=2, fsdp=2, tp=2))
     ta._fit_batch(_batches(1)[0])
     ck = ta.checkpointer(str(tmp_path), async_write=False)
-    ck.save(a)
-    # plant the bigger gang's leftover: a rank-1 shard from an older save
-    shutil.copy(tmp_path / "latest" / "shard_0.npz",
-                tmp_path / "latest" / "shard_1.npz")
+    gen1 = ck.save(a)
+    # plant the bigger gang's torn leftover AT the iteration the next save
+    # will use: a rank-1 shard + manifest in the not-yet-written gen dir
     ta._fit_batch(_batches(2)[-1])
-    ck.save(a)
-    assert not (tmp_path / "latest" / "shard_1.npz").exists()
+    next_gen = tmp_path / "latest" / _gen_name(int(a.iteration))
+    next_gen.mkdir()
+    shutil.copy(os.path.join(gen1, "shard_0.npz"), next_gen / "shard_1.npz")
+    shutil.copy(os.path.join(gen1, "manifest_0.json"),
+                next_gen / "manifest_1.json")
+    gen2 = ck.save(a)
+    assert gen2 == str(next_gen)
+    assert not (next_gen / "shard_1.npz").exists()
+    assert not (next_gen / "manifest_1.json").exists()
     b = _mlp(seed=99)
     tb = ParallelTrainer(b, mesh_layout=SpecLayout(data=2, fsdp=2, tp=2))
     assert tb.checkpointer(str(tmp_path), async_write=False).restore(b)
