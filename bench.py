@@ -181,6 +181,11 @@ def _scale(on_tpu):
                                   max_rows=128, fit_batch=128, fit_steps=4,
                                   flash=dict(B=1, H=12, T=8192, D=64,
                                              trials=3)),
+            "trace_overhead": dict(clients=8, requests_per_round=320,
+                                   rounds=3, batch_limit=16, features=64,
+                                   classes=8, queue=256, train_steps=30,
+                                   train_batch=256, train_features=256,
+                                   train_hidden=512),
         }
     return {
         "resnet50": dict(batch=8, hw=64, classes=10, steps=5, warmup=2, pipeline_steps=3),
@@ -210,6 +215,10 @@ def _scale(on_tpu):
         "compile_cache": dict(features=16, classes=4, batch_limit=8,
                               max_rows=32, fit_batch=32, fit_steps=2,
                               flash=dict(B=1, H=2, T=128, D=16, trials=1)),
+        "trace_overhead": dict(clients=4, requests_per_round=80, rounds=2,
+                               batch_limit=8, features=16, classes=4,
+                               queue=64, train_steps=6, train_batch=32,
+                               train_features=32, train_hidden=64),
     }
 
 
@@ -1880,13 +1889,171 @@ def bench_compile_cache(p):
     return out
 
 
+# ------------------------------------------------------------ trace overhead
+
+
+def bench_trace_overhead(p):
+    """ISSUE 16: what the fleet-timeline instrumentation costs when it is
+    ON at default sampling (flight ring + request spans + trace-id
+    propagation, span_sample_n=1) vs fully OFF (no TDL_FLIGHT_DIR, no
+    recorder). Two steady-state loops — serving req/s through the full
+    client→HTTP→executor stack, and the ParallelTrainer step path that
+    records step_begin/step_end — measured in alternating rounds so
+    machine drift hits both modes equally. Acceptance: ≤2%% at default
+    sampling."""
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu.monitoring import flight
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving import JsonModelClient, JsonModelServer
+
+    flight_dir = tempfile.mkdtemp(prefix="tdl_trace_bench_")
+    saved_env = os.environ.get(flight.ENV_DIR)
+
+    def set_mode(on: bool) -> None:
+        if on:
+            os.environ[flight.ENV_DIR] = flight_dir
+        else:
+            os.environ.pop(flight.ENV_DIR, None)
+
+    def median(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2] if vals else 0.0
+
+    def overhead_pct(off, on, higher_is_better):
+        if not off or not on:
+            return None
+        pct = ((off - on) / off if higher_is_better else (on - off) / off)
+        return round(pct * 100.0, 2)
+
+    out = {"metric": "trace_overhead_serving_pct", "unit": "%",
+           "rounds": p["rounds"]}
+    try:
+        # -- serving: req/s with spans+trace propagation on vs off --------
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=p["features"], n_out=64,
+                                  activation="relu"))
+                .layer(OutputLayer(n_out=p["classes"], activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        warm = np.zeros((1, p["features"]), np.float32)
+        set_mode(False)
+        server = (JsonModelServer.Builder(net).port(0)
+                  .batch_limit(p["batch_limit"]).queue_size(p["queue"])
+                  .warmup_input(warm).build().start())
+        if not server.wait_ready(60.0):
+            server.stop()
+            return {**out, "value": None, "error": "server never became ready"}
+        x = np.random.RandomState(0).randn(
+            1, p["features"]).astype(np.float32).tolist()
+        per_client = p["requests_per_round"] // p["clients"]
+
+        def one_round(tag):
+            done = [0]
+            lock = threading.Lock()
+
+            def worker(ci):
+                client = JsonModelClient(port=server.port, retries=2,
+                                         backoff_base=0.02, backoff_max=0.25)
+                n = 0
+                for i in range(per_client):
+                    try:  # trace id in BOTH modes: only recording differs
+                        client.predict(x, trace_id=f"{tag}-{ci}-{i}")
+                        n += 1
+                    except RuntimeError:
+                        pass
+                with lock:
+                    done[0] += n
+
+            threads = [threading.Thread(target=worker, args=(ci,))
+                       for ci in range(p["clients"])]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            return done[0] / dt if dt else 0.0
+
+        one_round("warm")  # executor warmup outside the measured rounds
+        rps_off, rps_on = [], []
+        for r in range(p["rounds"]):
+            set_mode(False)
+            rps_off.append(one_round(f"off{r}"))
+            set_mode(True)
+            rps_on.append(one_round(f"on{r}"))
+        server.stop(drain=True)
+
+        # -- training: ParallelTrainer step path (step_begin/step_end) ----
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.parallel import ParallelTrainer
+
+        tconf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+                 .list()
+                 .layer(DenseLayer(n_in=p["train_features"],
+                                   n_out=p["train_hidden"],
+                                   activation="relu"))
+                 .layer(OutputLayer(n_out=p["classes"], activation="softmax",
+                                    loss="mcxent"))
+                 .build())
+        tnet = MultiLayerNetwork(tconf).init()
+        trainer = ParallelTrainer(tnet)
+        rs = np.random.RandomState(0)
+        ds = DataSet(
+            rs.randn(p["train_batch"], p["train_features"]).astype(np.float32),
+            np.eye(p["classes"], dtype=np.float32)[
+                rs.randint(0, p["classes"], p["train_batch"])])
+        set_mode(False)
+        for _ in range(2):
+            trainer._fit_batch(ds)  # compile outside the measured rounds
+
+        def train_round():
+            t0 = time.perf_counter()
+            for _ in range(p["train_steps"]):
+                trainer._fit_batch(ds)
+            return (time.perf_counter() - t0) / p["train_steps"]
+
+        step_off, step_on = [], []
+        for _ in range(p["rounds"]):
+            set_mode(False)
+            step_off.append(train_round())
+            set_mode(True)
+            step_on.append(train_round())
+    finally:
+        if saved_env is None:
+            os.environ.pop(flight.ENV_DIR, None)
+        else:
+            os.environ[flight.ENV_DIR] = saved_env
+
+    r_off, r_on = median(rps_off), median(rps_on)
+    s_off, s_on = median(step_off), median(step_on)
+    serving_pct = overhead_pct(r_off, r_on, higher_is_better=True)
+    train_pct = overhead_pct(s_off, s_on, higher_is_better=False)
+    return {**out,
+            # headline value = serving overhead (the hot request path; the
+            # negative-is-noise convention matches compare_benchmarks)
+            "value": serving_pct,
+            "serving": {"rps_off": round(r_off, 1), "rps_on": round(r_on, 1),
+                        "overhead_pct": serving_pct},
+            "train": {"step_ms_off": round(s_off * 1e3, 3),
+                      "step_ms_on": round(s_on * 1e3, 3),
+                      "overhead_pct": train_pct},
+            "span_sample_n": 1, "target_pct": 2.0}
+
+
 BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "w2v": bench_w2v, "bert": bench_bert, "serving": bench_serving,
            "serving_slo": bench_serving_slo, "bert_large_fsdp": bench_fsdp,
            "serving_pool": bench_serving_pool,
            "reshard": bench_reshard,
            "ckpt_lineage": bench_ckpt_lineage,
-           "compile_cache": bench_compile_cache}
+           "compile_cache": bench_compile_cache,
+           "trace_overhead": bench_trace_overhead}
 
 
 # -------------------------------------------------------- regression compare

@@ -145,21 +145,43 @@ def maybe_spool(force: bool = False) -> None:
 
 def spool_read_errors(registry: Optional[MetricsRegistry] = None):
     """Get-or-create the spool-degradation counter (one declaration site):
-    spool files the scrape-time merge had to skip, by the proc identity in
-    the filename (``unknown`` when the name itself is mangled)."""
+    spool files a reader had to skip, labeled by which reader
+    (``metrics``/``history``/``flight``/``timeline``) and the proc identity
+    in the filename (``unknown`` when the name itself is mangled)."""
     r = registry if registry is not None else get_registry()
     return r.counter(
         "tdl_spool_read_errors_total",
-        "metrics spool files skipped by the scrape-time merge "
-        "(unreadable, torn, or not a JSON object)", labels=("proc",))
+        "spool files skipped by a reader "
+        "(unreadable, torn, or not a JSON object)",
+        labels=("reader", "proc"))
 
 
-def _spool_proc_from_filename(name: str) -> str:
+def _spool_proc_from_filename(name: str, prefix: str = None) -> str:
     # tdl_metrics_<proc>.<pid>.json — proc may itself contain dots, so strip
-    # the two KNOWN trailing components, not the first dot
-    stem = name[len(SPOOL_PREFIX):]
+    # the two KNOWN trailing components, not the first dot. Flight/op-trace
+    # spools have no pid component: tdl_flight_<proc>.json.
+    stem = name[len(prefix if prefix is not None else SPOOL_PREFIX):]
     parts = stem.rsplit(".", 2)
-    return parts[0] if len(parts) == 3 and parts[0] else "unknown"
+    if len(parts) == 3 and parts[0]:
+        return parts[0]
+    if len(parts) == 2 and parts[1] == "json" and parts[0]:
+        return parts[0]
+    return "unknown"
+
+
+def spool_error_counter(reader: str,
+                        registry: Optional[MetricsRegistry] = None,
+                        prefix: str = None):
+    """An ``on_error`` callback for :func:`flight.scan_spool_json` call
+    sites: bumps ``tdl_spool_read_errors_total{reader, proc}`` per skipped
+    file. Every reader of a spool directory passes one of these instead of
+    silently dropping torn spools (ISSUE 16 satellite)."""
+    errors = spool_read_errors(registry)
+
+    def note_error(name: str) -> None:
+        errors.labels(reader, _spool_proc_from_filename(name, prefix)).inc()
+
+    return note_error
 
 
 def read_spools(directory: str,
@@ -172,14 +194,12 @@ def read_spools(directory: str,
     such spools accumulate until the directory is rotated.
 
     Unreadable / torn / non-object spool files are SKIPPED and counted in
-    ``tdl_spool_read_errors_total{proc}`` on ``registry`` (default: the
-    process registry) — one corrupt file degrades one proc's view, never
-    the whole merged scrape, and the degradation counter lands on the SAME
-    registry the caller's scrape serves (ISSUE 11 satellite)."""
+    ``tdl_spool_read_errors_total{reader="metrics", proc}`` on ``registry``
+    (default: the process registry) — one corrupt file degrades one proc's
+    view, never the whole merged scrape, and the degradation counter lands
+    on the SAME registry the caller's scrape serves (ISSUE 11 satellite)."""
     errors = spool_read_errors(registry)
-
-    def note_error(name: str) -> None:
-        errors.labels(_spool_proc_from_filename(name)).inc()
+    note_error = spool_error_counter("metrics", registry)
 
     newest: Dict[str, dict] = {}
     for payload in scan_spool_json(directory, SPOOL_PREFIX,
@@ -189,7 +209,7 @@ def read_spools(directory: str,
             # parsed but wrong shape: same degradation bucket
             proc = (str(payload.get("proc") or "unknown")
                     if isinstance(payload, dict) else "unknown")
-            errors.labels(proc).inc()
+            errors.labels("metrics", proc).inc()
             continue
         proc = str(payload.get("proc", ""))
         if (proc not in newest

@@ -42,12 +42,59 @@ ENV_INTERVAL = "TDL_FLIGHT_INTERVAL"
 ENV_LOSS_EVERY = "TDL_FLIGHT_LOSS_EVERY"
 ENV_RANK = "TDL_PROCESS_ID"
 ENV_PROC = "TDL_PROC_NAME"
+ENV_RUN_ID = "TDL_RUN_ID"
 
 #: spool filename prefix — the leak-audit conftest fixture and the
 #: supervisor's postmortem collector both key on it
 SPOOL_PREFIX = "tdl_flight_"
 
 DEFAULT_CAPACITY = 512
+
+#: anchors kept per spool: the open anchor plus the most recent flushes —
+#: enough pairs for a robust (median) monotonic↔wall offset without letting
+#: a long-lived recorder's payload grow one anchor per flush forever
+MAX_ANCHORS = 16
+
+#: THE flight-event vocabulary. Every ``flight.record(kind=...)`` literal in
+#: the package must be declared here (tests/test_timeline.py AST lint) and
+#: documented in docs/OBSERVABILITY.md's event table — an event kind that
+#: exists only at its record site is invisible to the timeline/postmortem
+#: readers that switch on it.
+EVENT_KINDS = frozenset({
+    # training step / fit loop
+    "step_begin", "step_end", "heartbeat", "compile",
+    # checkpoint lineage
+    "ckpt_save", "ckpt_commit", "ckpt_restore", "ckpt_quarantine",
+    "ckpt_fallback", "ckpt_reshard",
+    # chaos / fault injection
+    "fault_injected",
+    # alerts
+    "alert", "alert_clear",
+    # serving request path
+    "request_span", "route", "queue_hwm",
+    # gang supervisor
+    "gang_failure", "restart_decision", "gang_resize",
+    # serving pool
+    "pool_scale", "pool_swap_rejected", "pool_swap_begin", "pool_swap",
+    "pool_swap_rollback", "replica_spawn", "replica_retire",
+    "replica_drain_complete", "replica_death", "replica_breaker_open",
+})
+
+
+def clock_anchor() -> dict:
+    """One monotonic↔wall sample. A spool carrying a few of these lets a
+    reader on any machine map the spool's monotonic timestamps onto the wall
+    clock (``monitoring.timeline`` medians them), which is what aligns
+    per-process lanes after a restart or across hosts whose boots differ."""
+    return {"mono": time.monotonic(),
+            "wall": time.time()}  # wallclock-ok: one half of the clock-skew anchor pair, never a duration
+
+
+def run_id() -> Optional[str]:
+    """The fleet run id (``TDL_RUN_ID``) — minted by the ``GangSupervisor``
+    / ``ServingPool`` and inherited by every child, so spans and flight
+    events from all ranks/replicas of one run correlate in a shared dir."""
+    return os.environ.get(ENV_RUN_ID) or None
 
 
 def proc_name(rank: Optional[int] = None) -> str:
@@ -112,12 +159,16 @@ class FlightRecorder:
 
     def __init__(self, proc: Optional[str] = None,
                  directory: Optional[str] = None,
-                 capacity: int = DEFAULT_CAPACITY, interval: float = 1.0):
+                 capacity: int = DEFAULT_CAPACITY, interval: float = 1.0,
+                 run: Optional[str] = None):
         self.proc = proc or proc_name()
         self.directory = directory
         self.capacity = max(1, int(capacity))
         self.interval = max(0.0, float(interval))
+        self.run_id = run if run is not None else run_id()
+        self.rank = proc_rank()
         self._events: deque = deque(maxlen=self.capacity)
+        self._anchors: deque = deque([clock_anchor()], maxlen=MAX_ANCHORS)
         self._lock = threading.Lock()
         self._seq = 0
         self._last_spool: Optional[float] = None
@@ -135,6 +186,10 @@ class FlightRecorder:
         ev = {"t": time.monotonic(),
               "wall": time.time(),  # wallclock-ok: event timestamp for humans, never compared as a duration
               "proc": self.proc, "pid": os.getpid(), "kind": str(kind)}
+        if self.run_id is not None:
+            ev["run_id"] = self.run_id
+        if self.rank is not None:
+            ev["rank"] = self.rank
         ev.update(fields)
         with self._lock:
             ev["seq"] = self._seq
@@ -159,8 +214,16 @@ class FlightRecorder:
         path = self.path
         if path is None:
             return None
+        with self._lock:
+            self._anchors.append(clock_anchor())
+            anchors = list(self._anchors)
         payload = {"proc": self.proc, "pid": os.getpid(),
-                   "capacity": self.capacity, "events": self.events()}
+                   "capacity": self.capacity, "anchors": anchors,
+                   "events": self.events()}
+        if self.run_id is not None:
+            payload["run_id"] = self.run_id
+        if self.rank is not None:
+            payload["rank"] = self.rank
         try:
             atomic_json_write(path, payload)
         except Exception:
@@ -210,11 +273,11 @@ def get_flight_recorder() -> Optional[FlightRecorder]:
     directory = os.environ.get(ENV_DIR)
     if not directory:
         return None
-    key = (directory, os.environ.get(ENV_RANK),
+    key = (directory, os.environ.get(ENV_RANK), os.environ.get(ENV_RUN_ID),
            float(os.environ.get(ENV_INTERVAL, "1.0")))
     if _recorder is None or key != _recorder_key:
         try:
-            _recorder = FlightRecorder(directory=directory, interval=key[2])
+            _recorder = FlightRecorder(directory=directory, interval=key[3])
         except OSError:
             # unwritable flight dir: record in memory only (flush no-ops) —
             # never kill the step that wanted to leave a breadcrumb
@@ -253,10 +316,12 @@ def loss_every() -> int:
 # -- postmortem assembly -----------------------------------------------------
 
 
-def read_spools(directory: str) -> List[dict]:
+def read_spools(directory: str, on_error=None) -> List[dict]:
     """Every flight spool in ``directory`` (unreadable/torn files skipped —
-    a postmortem assembled mid-crash must not raise)."""
-    return scan_spool_json(directory, SPOOL_PREFIX)
+    a postmortem assembled mid-crash must not raise). Pass
+    ``aggregate.spool_error_counter("flight")`` (or any callable taking the
+    skipped filename) as ``on_error`` to count the degradation."""
+    return scan_spool_json(directory, SPOOL_PREFIX, on_error=on_error)
 
 
 def merge_events(spools: List[dict], extra_events: List[dict] = ()) -> List[dict]:

@@ -15,13 +15,70 @@ not taxed with an fsync per iteration.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import sys
 import time
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
 
 ENV_DIR = "TDL_HEARTBEAT_DIR"
 ENV_INTERVAL = "TDL_HEARTBEAT_INTERVAL"
 ENV_RANK = "TDL_PROCESS_ID"
+
+
+def sample_memory(registry=None) -> Dict[str, int]:
+    """Memory telemetry piggybacked on the heartbeat cadence (ISSUE 16):
+    host RSS plus — when jax is ALREADY imported — per-device
+    ``memory_stats()`` into the ``tdl_mem_*`` gauges. Never imports jax
+    itself (an unsupervised CPU process must not pay backend init for a
+    heartbeat), and never raises: memory numbers are telemetry, not
+    control flow. Returns {label: bytes} for what it sampled."""
+    from .registry import get_registry  # lazy: keep import-time deps flat
+
+    reg = registry if registry is not None else get_registry()
+    out: Dict[str, int] = {}
+    try:
+        from .watchdogs import host_rss_bytes
+
+        rss = int(host_rss_bytes())
+        reg.gauge("tdl_mem_host_rss_bytes",
+                  "Resident set size of this process (VmRSS; getrusage "
+                  "high-water fallback where /proc is absent)").set(rss)
+        out["host_rss"] = rss
+    except Exception:
+        log.debug("host RSS sampling failed", exc_info=True)
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return out
+    try:
+        in_use_g = reg.gauge(
+            "tdl_mem_device_bytes_in_use",
+            "Device memory currently allocated (jax memory_stats, sampled "
+            "each heartbeat write)", labels=("device",))
+        peak_g = reg.gauge(
+            "tdl_mem_device_peak_bytes",
+            "Backend-reported peak device memory since process start",
+            labels=("device",))
+        for d in jax.local_devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:  # backend without the API
+                stats = None
+            if not isinstance(stats, dict):
+                continue
+            label = f"{d.platform}:{d.id}"
+            in_use = int(stats.get("bytes_in_use", 0))
+            in_use_g.labels(label).set(in_use)
+            out[label] = in_use
+            peak = stats.get("peak_bytes_in_use")
+            if isinstance(peak, (int, float)):
+                peak_g.labels(label).set(int(peak))
+    except Exception:
+        log.debug("device memory sampling failed", exc_info=True)
+    return out
 
 
 def heartbeat_path(directory: str, rank: int) -> str:
@@ -53,6 +110,9 @@ class HeartbeatWriter:
         from . import flight  # lazy: flight imports nothing from here
 
         flight.record("heartbeat", iteration=int(iteration), rank=self.rank)
+        # memory gauges ride the SAME throttle — one sample per actual
+        # heartbeat write, zero extra cost on suppressed beats
+        sample_memory()
         return True
 
 

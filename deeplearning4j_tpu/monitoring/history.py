@@ -205,9 +205,13 @@ def read_rings(directory: str) -> List[dict]:
     """Every history-ring spool in ``directory``, newest file per proc
     identity (a respawned incarnation's predecessor must not double-count —
     same dedup rule as ``aggregate.read_spools``). Unreadable / torn /
-    non-dict payloads are skipped."""
+    non-dict payloads are skipped and counted in
+    ``tdl_spool_read_errors_total{reader="history"}``."""
+    from .aggregate import spool_error_counter
+    note_error = spool_error_counter("history", prefix=SPOOL_PREFIX)
     newest: Dict[str, dict] = {}
-    for payload in scan_spool_json(directory, SPOOL_PREFIX):
+    for payload in scan_spool_json(directory, SPOOL_PREFIX,
+                                   on_error=note_error):
         if not isinstance(payload, dict):
             continue
         proc = str(payload.get("proc", ""))

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from collections import defaultdict
@@ -18,6 +19,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 logger = logging.getLogger("deeplearning4j_tpu")
+
+#: op-trace spool filename prefix — ``monitoring.timeline.build_timeline``
+#: scans for it next to the flight spools
+SPOOL_PREFIX = "tdl_optrace_"
 
 
 @dataclass
@@ -35,14 +40,36 @@ class _OpStat:
 
 
 class OpProfiler:
-    """Per-op-class counters/timings with reset/print, chrome-trace export."""
+    """Per-op-class counters/timings with reset/print, chrome-trace export.
 
-    def __init__(self, config: Optional[ProfilerConfig] = None):
+    Event ``ts`` values are microseconds relative to the profiler's own
+    ``perf_counter_ns`` origin — a private clock no other process shares.
+    ``anchors`` pairs that clock with the wall clock (one pair at open /
+    reset and one per spool flush), which is what lets
+    ``monitoring.timeline.build_timeline`` place this profiler's ops on the
+    fleet-wide wall-aligned axis next to every other process's lane.
+    """
+
+    def __init__(self, config: Optional[ProfilerConfig] = None,
+                 proc: Optional[str] = None,
+                 directory: Optional[str] = None):
         self.config = config or ProfilerConfig()
+        self.proc = proc
+        self.directory = directory
         self._stats: Dict[str, _OpStat] = defaultdict(_OpStat)
         self._events: List[dict] = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter_ns()
+        self._anchors: List[dict] = [self._anchor()]
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+
+    def _anchor(self) -> dict:
+        """monotonic↔wall pair in the events' own clock (seconds since the
+        profiler origin) — call sites hold no lock; appending is done by the
+        caller under ``self._lock``."""
+        return {"mono": (time.perf_counter_ns() - self._t0) / 1e9,
+                "wall": time.time()}  # wallclock-ok: clock-skew anchor for the timeline merge, never a duration
 
     def record(self, op_name: str, duration_ns: int = 0) -> None:
         with self._lock:
@@ -85,6 +112,7 @@ class OpProfiler:
             self._stats.clear()
             self._events.clear()
             self._t0 = time.perf_counter_ns()
+            self._anchors = [self._anchor()]
 
     def print_stats(self) -> str:
         lines = ["Op profile:"]
@@ -100,6 +128,39 @@ class OpProfiler:
             events = list(self._events)
         with open(path, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+    @property
+    def spool_path(self) -> Optional[str]:
+        if self.directory is None:
+            return None
+        from ..monitoring.flight import proc_name
+        proc = self.proc or proc_name()
+        return os.path.join(self.directory, f"{SPOOL_PREFIX}{proc}.json")
+
+    def flush(self) -> Optional[str]:
+        """Spool events + anchors for the fleet-timeline merge (atomic
+        tmp+rename, same contract as the flight recorder). No-op without a
+        directory; failures are swallowed — profiling must never take the
+        workload down."""
+        path = self.spool_path
+        if path is None:
+            return None
+        from ..monitoring.flight import atomic_json_write, proc_name, run_id
+        with self._lock:
+            self._anchors.append(self._anchor())
+            payload = {"proc": self.proc or proc_name(), "pid": os.getpid(),
+                       "anchors": list(self._anchors),
+                       "events": list(self._events)}
+        rid = run_id()
+        if rid is not None:
+            payload["run_id"] = rid
+        try:
+            atomic_json_write(path, payload)
+        except Exception:
+            logger.exception("op-trace spool to %s failed (workload continues)",
+                             path)
+            return None
+        return path
 
 
 class ProfileAnalyzer:

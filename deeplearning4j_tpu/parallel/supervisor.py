@@ -266,9 +266,15 @@ class GangSupervisor:
         from ..monitoring.partition import elastic_metrics
 
         self._resizes_ctr = elastic_metrics(self.registry).gang_resizes
+        # one run id for the whole gang (ISSUE 16): every rank inherits it
+        # via TDL_RUN_ID, so each spool — and the merged fleet timeline —
+        # can say which supervised run its events belong to
+        import uuid
+
+        self.run_id = uuid.uuid4().hex[:12]
         # the supervisor's own black box (restart decisions, classifications);
         # ring-only — its events merge into postmortem.json from memory
-        self._flight = FlightRecorder(proc="supervisor")
+        self._flight = FlightRecorder(proc="supervisor", run=self.run_id)
         self.last_failure: Optional[Dict] = None
         #: merged flight-recorder timeline of the most recent failure
         self.postmortem_path = os.path.join(self.workdir, "postmortem.json")
@@ -393,6 +399,9 @@ class GangSupervisor:
         self.flight_dir = os.path.join(self.workdir, f"flight_{attempt}")
         env.setdefault(flight.ENV_DIR, self.flight_dir)
         env.setdefault(flight.ENV_INTERVAL, str(self.heartbeat_interval))
+        # every rank stamps the gang's run id into its spans/flight events —
+        # the fleet timeline groups lanes by it (ISSUE 16)
+        env.setdefault(flight.ENV_RUN_ID, self.run_id)
         env.setdefault(aggregate.ENV_DIR, self.spool_dir)
         env.setdefault(aggregate.ENV_INTERVAL, str(self.heartbeat_interval))
         # history rings (ISSUE 11) are STABLE across attempts like the
@@ -573,7 +582,10 @@ class GangSupervisor:
         if not self.events:
             return
         flight_dir = getattr(self, "flight_dir", None)
-        spools = flight.read_spools(flight_dir) if flight_dir else []
+        spools = flight.read_spools(
+            flight_dir, on_error=aggregate.spool_error_counter(
+                "flight", self.registry, prefix=flight.SPOOL_PREFIX)) \
+            if flight_dir else []
         if not any(e.get("kind") in ("ckpt_quarantine", "ckpt_fallback")
                    for e in flight.merge_events(spools, [])):
             return
@@ -590,7 +602,10 @@ class GangSupervisor:
         lets a caller that already read them skip the second disk pass."""
         if spools is None:
             flight_dir = getattr(self, "flight_dir", None)
-            spools = flight.read_spools(flight_dir) if flight_dir else []
+            spools = flight.read_spools(
+                flight_dir, on_error=aggregate.spool_error_counter(
+                    "flight", self.registry, prefix=flight.SPOOL_PREFIX)) \
+                if flight_dir else []
         events = flight.merge_events(spools, self._flight.events())
         doc = {
             "classification": classification or failure.reason,
@@ -627,6 +642,10 @@ class GangSupervisor:
                 doc["checkpoint"] = lineage_state(self.ckpt_dir)
             except Exception as e:  # inventory is evidence, never a new crash
                 doc["checkpoint"] = {"error": str(e)}
+        # the fleet timeline rides along (ISSUE 16): every attempt's flight
+        # spools + the supervisor's own ring, skew-corrected into one
+        # Perfetto-loadable chrome trace next to the postmortem
+        doc["timeline"] = self._write_timeline_artifact()
         tmp = self.postmortem_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1)
@@ -634,6 +653,28 @@ class GangSupervisor:
         log.warning("postmortem written to %s (%d events from %d procs)",
                     self.postmortem_path, len(events), len(doc["procs"]))
         return self.postmortem_path
+
+    def _write_timeline_artifact(self) -> Optional[str]:
+        """``workdir/timeline.json``: the merged chrome trace over EVERY
+        attempt's flight dir (a postmortem wants the crashed incarnation
+        AND its respawn on the same wall axis). Evidence, never a new
+        crash — returns None on failure."""
+        from ..monitoring import timeline as _timeline
+
+        try:
+            dirs = sorted(
+                os.path.join(self.workdir, d)
+                for d in os.listdir(self.workdir)
+                if d.startswith("flight_")
+                and os.path.isdir(os.path.join(self.workdir, d)))
+            return _timeline.write_timeline(
+                os.path.join(self.workdir, "timeline.json"),
+                flight_dirs=dirs, extra_events=self._flight.events(),
+                registry=self.registry)
+        except Exception:
+            log.exception("fleet-timeline export failed (postmortem "
+                          "continues without it)")
+            return None
 
     # -------------------------------------------------------- classification
 

@@ -77,6 +77,13 @@ def span_sampled(request_id: Optional[str], sample_n: int) -> bool:
     return zlib.crc32(request_id.encode()) % sample_n == 0
 
 
+def _trace_kw(fut) -> dict:
+    """The trace-id kwarg for a ``request_span`` record site (empty when the
+    request carried no trace id — a span without one still records)."""
+    trace_id = getattr(fut, "trace_id", None)
+    return {"trace_id": trace_id} if trace_id else {}
+
+
 class QueueFullError(RuntimeError):
     """Admission queue at capacity — callers map this to HTTP 429."""
 
@@ -98,13 +105,20 @@ class InferenceFuture:
     """
 
     __slots__ = ("x", "deadline", "enqueued_at", "result", "error", "_done",
-                 "abandoned", "_lock", "request_id", "sampled", "span")
+                 "abandoned", "_lock", "request_id", "trace_id", "sampled",
+                 "span")
 
     def __init__(self, x: np.ndarray, deadline: Optional[float],
-                 request_id: Optional[str] = None, sampled: bool = False):
+                 request_id: Optional[str] = None, sampled: bool = False,
+                 trace_id: Optional[str] = None):
         self.x = x
         self.deadline = deadline
         self.request_id = request_id
+        #: trace propagation (ISSUE 16): the id the HTTP layer adopted from
+        #: ``X-Trace-Id`` (or inherited from the request id) — stamped into
+        #: every ``request_span`` flight event this future produces, so the
+        #: fleet timeline joins this request across process lanes
+        self.trace_id = trace_id
         self.enqueued_at = time.monotonic()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -261,11 +275,13 @@ class BatchingInferenceExecutor:
     # -- admission ---------------------------------------------------------
 
     def submit(self, x, deadline_ms: Optional[float] = None,
-               request_id: Optional[str] = None) -> InferenceFuture:
+               request_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> InferenceFuture:
         """Admit one request. Raises :class:`QueueFullError` at capacity,
         :class:`ExecutorClosedError` when stopped/draining, ``ValueError``
         on inputs with no batch dimension. ``request_id`` (the server's
-        ``X-Request-Id``) rides the future into every executor log line."""
+        ``X-Request-Id``) rides the future into every executor log line;
+        ``trace_id`` rides into its ``request_span`` events (ISSUE 16)."""
         arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
         if arr.ndim == 0:
             raise ValueError("inference input must have a batch dimension; "
@@ -274,7 +290,7 @@ class BatchingInferenceExecutor:
         deadline = time.monotonic() + ms / 1000.0 if ms is not None else None
         sampled = span_sampled(request_id, self.span_sample_n)
         fut = InferenceFuture(arr, deadline, request_id=request_id,
-                              sampled=sampled)
+                              sampled=sampled, trace_id=trace_id)
         return self._admit(fut)
 
     def _admit(self, fut: InferenceFuture) -> InferenceFuture:
@@ -309,7 +325,8 @@ class BatchingInferenceExecutor:
                 # the admission lock like every breadcrumb here
                 flight.record("request_span", request_id=request_id,
                               outcome="shed_queue_full", code=429,
-                              queue_depth=self.max_queue, phases={})
+                              queue_depth=self.max_queue, phases={},
+                              **_trace_kw(fut))
             raise QueueFullError(
                 f"admission queue full ({self.max_queue} queued)")
         if new_hwm:
@@ -396,7 +413,8 @@ class BatchingInferenceExecutor:
                                   request_id=req.request_id,
                                   outcome="shed_deadline", code=504,
                                   abandoned=not owns_count,
-                                  phases={"queue": now - req.enqueued_at})
+                                  phases={"queue": now - req.enqueued_at},
+                                  **_trace_kw(req))
             else:
                 live.append(req)
         if not live:
@@ -448,7 +466,7 @@ class BatchingInferenceExecutor:
             extra = {k: phases.pop(k) for k in SPAN_EXTRA_KEYS if k in phases}
             flight.record("request_span", request_id=r.request_id,
                           outcome="shed_deadline", code=504, abandoned=True,
-                          phases=phases, **extra)
+                          phases=phases, **extra, **_trace_kw(r))
 
     @staticmethod
     def _fill_spans(reqs: List[InferenceFuture], t_pop: float,
@@ -491,8 +509,9 @@ class GenerationFuture(InferenceFuture):
 
     def __init__(self, x: np.ndarray, deadline: Optional[float],
                  max_new_tokens: int, request_id: Optional[str] = None,
-                 sampled: bool = False):
-        super().__init__(x, deadline, request_id=request_id, sampled=sampled)
+                 sampled: bool = False, trace_id: Optional[str] = None):
+        super().__init__(x, deadline, request_id=request_id, sampled=sampled,
+                         trace_id=trace_id)
         self.max_new_tokens = max_new_tokens
         self.tokens: List[int] = []
         self.steps = 0
@@ -557,7 +576,8 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
 
     def submit(self, x, deadline_ms: Optional[float] = None,
                request_id: Optional[str] = None,
-               max_new_tokens: Optional[int] = None) -> GenerationFuture:
+               max_new_tokens: Optional[int] = None,
+               trace_id: Optional[str] = None) -> GenerationFuture:
         """Admit one generation request. ``x`` is a 1-D token sequence (a
         ``[1, T]`` row is accepted and squeezed). Raises ``ValueError`` on
         non-integer tokens, a bad budget, or a prompt that cannot fit the
@@ -601,7 +621,8 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
         deadline = time.monotonic() + ms / 1000.0 if ms is not None else None
         fut = GenerationFuture(
             arr, deadline, mnt, request_id=request_id,
-            sampled=span_sampled(request_id, self.span_sample_n))
+            sampled=span_sampled(request_id, self.span_sample_n),
+            trace_id=trace_id)
         return self._admit(fut)
 
     # -- decode loop -------------------------------------------------------
@@ -682,7 +703,8 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
                 flight.record("request_span", request_id=fut.request_id,
                               outcome="shed_deadline", code=504,
                               abandoned=not owns,
-                              phases={"queue": now - fut.enqueued_at})
+                              phases={"queue": now - fut.enqueued_at},
+                              **_trace_kw(fut))
             return
         try:
             fault_point("infer")
@@ -789,7 +811,8 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
                     phases = self._span_phases(fut)
                     flight.record("request_span", request_id=fut.request_id,
                                   outcome="shed_deadline", code=504,
-                                  abandoned=not owns, **phases)
+                                  abandoned=not owns, **phases,
+                                  **_trace_kw(fut))
         self._md.slot_occupancy.set(len(active))
 
     def _finish(self, fut: GenerationFuture) -> None:
@@ -811,7 +834,8 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
         if abandoned and fut.sampled:
             flight.record("request_span", request_id=fut.request_id,
                           outcome="shed_deadline", code=504, abandoned=True,
-                          **GenerativeInferenceExecutor._span_phases(fut))
+                          **GenerativeInferenceExecutor._span_phases(fut),
+                          **_trace_kw(fut))
 
     # -- introspection -----------------------------------------------------
 

@@ -73,6 +73,18 @@ def _request_id(header_value: Optional[str]) -> str:
     return uuid.uuid4().hex[:16]
 
 
+def _trace_id(header_value: Optional[str], rid: str) -> str:
+    """The request's TRACE id (ISSUE 16): adopt the client's/router's
+    ``X-Trace-Id`` when sane, else inherit the request id — so one id joins
+    the router's ``route`` slice and the replica's ``request_span`` into one
+    flow on the fleet timeline, whether or not the hop upstream minted
+    one."""
+    tr = (header_value or "").strip()
+    if tr and len(tr) <= _REQUEST_ID_MAX and tr.isprintable():
+        return tr
+    return rid
+
+
 class JsonModelServer:
     def __init__(self, model, port: int = 0,
                  deserializer: Optional[Callable[[Any], np.ndarray]] = None,
@@ -266,11 +278,14 @@ class JsonModelServer:
         except OSError:
             log.debug("client stalled while its oversized body was drained")
 
-    def _handle_predict(self, handler,
-                        rid: Optional[str] = None) -> Tuple[int, dict, Optional[int]]:
+    def _handle_predict(self, handler, rid: Optional[str] = None,
+                        trace_id: Optional[str] = None,
+                        ) -> Tuple[int, dict, Optional[int]]:
         """Returns (status, json body, Retry-After seconds or None)."""
         rid = rid if rid is not None else _request_id(
             handler.headers.get("X-Request-Id"))
+        trace_id = trace_id if trace_id is not None else _trace_id(
+            handler.headers.get("X-Trace-Id"), rid)
         content_length = handler.headers.get("Content-Length")
         try:
             length = int(content_length)
@@ -327,7 +342,7 @@ class JsonModelServer:
             return 400, {"error": f"{type(e).__name__}: {e}"}, None
         try:
             fut = executor.submit(x, deadline_ms=deadline_ms, request_id=rid,
-                                  **submit_kw)
+                                  trace_id=trace_id, **submit_kw)
         except QueueFullError as e:
             return 429, {"error": str(e)}, RETRY_AFTER_S
         except ExecutorClosedError as e:
@@ -384,6 +399,9 @@ class JsonModelServer:
         extra = {k: phases.pop(k) for k in SPAN_EXTRA_KEYS if k in phases}
         if serialize is not None:
             phases["serialize"] = serialize
+        trace_id = getattr(fut, "trace_id", None)
+        if trace_id is not None:
+            extra["trace_id"] = trace_id
         flight.record("request_span", request_id=rid, outcome=outcome,
                       code=code, phases=phases, **extra)
 
@@ -426,7 +444,8 @@ class JsonModelServer:
             def log_message(self, *args):
                 pass
 
-            def _json(self, obj, code=200, retry_after=None, request_id=None):
+            def _json(self, obj, code=200, retry_after=None, request_id=None,
+                      trace_id=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -435,6 +454,8 @@ class JsonModelServer:
                     self.send_header("Retry-After", str(retry_after))
                 if request_id is not None:
                     self.send_header("X-Request-Id", request_id)
+                if trace_id is not None:
+                    self.send_header("X-Trace-Id", trace_id)
                 self.end_headers()
                 try:
                     self.wfile.write(body)
@@ -450,9 +471,12 @@ class JsonModelServer:
                     # body (incl. 429/504/413 error JSON), so a client-
                     # reported slow request is greppable in server telemetry
                     rid = _request_id(self.headers.get("X-Request-Id"))
-                    code, obj, retry_after = server._handle_predict(self, rid)
+                    tid = _trace_id(self.headers.get("X-Trace-Id"), rid)
+                    code, obj, retry_after = server._handle_predict(
+                        self, rid, trace_id=tid)
                     obj.setdefault("request_id", rid)
-                    self._json(obj, code, retry_after, request_id=rid)
+                    self._json(obj, code, retry_after, request_id=rid,
+                               trace_id=tid)
                     server._m.requests.labels(code=str(code)).inc()
                     server._m.latency.observe(time.perf_counter() - t0)
                 finally:
@@ -598,7 +622,8 @@ class JsonModelClient:
         return "bad_request"
 
     def predict(self, data, deadline_ms: Optional[float] = None,
-                request_id: Optional[str] = None) -> Any:
+                request_id: Optional[str] = None,
+                trace_id: Optional[str] = None) -> Any:
         import http.client
         import urllib.error
         import urllib.request
@@ -620,6 +645,10 @@ class JsonModelClient:
             # correlation key (ISSUE 11): the server echoes it and the
             # executor's request_span timeline joins on it
             headers["X-Request-Id"] = str(request_id)
+        if trace_id is not None:
+            # fleet-timeline flow key (ISSUE 16): every hop adopts it, so
+            # router + replica lanes join on one id in the merged trace
+            headers["X-Trace-Id"] = str(trace_id)
         last_msg = f"no response from {self.url}"
         try:
             for attempt in range(self.retries + 1):

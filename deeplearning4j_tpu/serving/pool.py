@@ -83,8 +83,8 @@ RETRY_AFTER_S = 1
 #: router-level request-body cap (the replica enforces its own too)
 DEFAULT_MAX_BODY_BYTES = 16 << 20
 #: headers the router forwards verbatim to the chosen replica
-_FORWARD_HEADERS = ("X-Request-Id", "X-Deadline-Ms", "X-Max-New-Tokens",
-                    "Content-Type")
+_FORWARD_HEADERS = ("X-Request-Id", "X-Trace-Id", "X-Deadline-Ms",
+                    "X-Max-New-Tokens", "Content-Type")
 
 
 # ------------------------------------------------------------ replica entry
@@ -139,6 +139,9 @@ def _replica_main(argv: Sequence[str]) -> None:
         aggregate.maybe_spool()
     server.stop(drain=True)
     aggregate.maybe_spool(force=True)
+    # the final spans must reach the spool the fleet timeline reads — the
+    # throttled in-loop flushes may be up to one interval behind
+    flight.flush()
 
 
 # ---------------------------------------------------------------- the pool
@@ -246,6 +249,12 @@ class ServingPool:
         self.history_dir = os.path.join(self.workdir, "history")
         self.compile_cache_dir = os.path.join(self.workdir, "compile_cache")
         self.hb_dir = os.path.join(self.workdir, "hb")
+        self.flight_dir = os.path.join(self.workdir, "flight")
+        #: run identity (ISSUE 16): replicas inherit it via TDL_RUN_ID, so
+        #: every lane of this pool's fleet timeline carries the same run id
+        import uuid
+
+        self.run_id = uuid.uuid4().hex[:12]
         self._ports_dir = os.path.join(self.workdir, "ports")
         self._logs_dir = os.path.join(self.workdir, "logs")
         for d in (self.hb_dir, self._ports_dir, self._logs_dir):
@@ -582,6 +591,27 @@ class ServingPool:
                 } for h in self._replicas.values()],
             }
 
+    def write_timeline(self, path: Optional[str] = None) -> str:
+        """Merge every replica's flight spool (plus the router's own ring)
+        into ONE Perfetto-loadable chrome-trace JSON under the workdir —
+        request flows join the router's `route` slices to the replicas'
+        request_spans by trace id. Returns the artifact path."""
+        from ..monitoring import timeline as _timeline
+        path = path or os.path.join(self.workdir, "timeline.json")
+        dirs = [self.flight_dir]
+        extra: List[dict] = []
+        rec = flight.get_flight_recorder() if flight.active() else None
+        if rec is not None:
+            if rec.directory is None:
+                extra = rec.events()  # in-memory ring: no spool to scan
+            else:
+                rec.flush()
+                if rec.directory != self.flight_dir:
+                    dirs.append(rec.directory)
+        return _timeline.write_timeline(path, flight_dirs=dirs,
+                                        extra_events=extra,
+                                        registry=self.registry)
+
     def _readiness(self) -> Tuple[bool, str]:
         ready = self.ready_count
         if ready >= self.min_replicas:
@@ -626,7 +656,8 @@ class ServingPool:
         env.setdefault(aggregate.ENV_DIR, self.spool_dir)
         env.setdefault(aggregate.ENV_INTERVAL, str(self.heartbeat_interval))
         env.setdefault(history.ENV_DIR, self.history_dir)
-        env.setdefault(flight.ENV_DIR, os.path.join(self.workdir, "flight"))
+        env.setdefault(flight.ENV_DIR, self.flight_dir)
+        env.setdefault(flight.ENV_RUN_ID, self.run_id)
         # stable executable cache: replica N+1's warmup (and a respawn of
         # replica N) restores what the first warmup compiled — the ISSUE 12
         # cache is what makes elastic scale-out cheap
@@ -981,9 +1012,14 @@ class ServingPool:
         import urllib.error
         import urllib.request
 
-        from .json_server import JsonModelServer, _request_id
+        from .executor import span_sampled
+        from .json_server import JsonModelServer, _request_id, _trace_id
 
         rid = _request_id(handler.headers.get("X-Request-Id"))
+        # mint-or-adopt the trace id (ISSUE 16): forwarded replica-ward so
+        # the router's `route` slice and the replica's request_span join
+        # into one flow on the fleet timeline
+        tid = _trace_id(handler.headers.get("X-Trace-Id"), rid)
         content_length = handler.headers.get("Content-Length")
         try:
             length = int(content_length)
@@ -1020,12 +1056,26 @@ class ServingPool:
             return (408, json.dumps({"error": "timed out reading body",
                                      "request_id": rid}).encode(),
                     "application/json", None, {"X-Request-Id": rid})
-        fwd_headers = {"X-Request-Id": rid}
+        fwd_headers = {}
         for name in _FORWARD_HEADERS:
             v = handler.headers.get(name)
             if v is not None:
                 fwd_headers[name] = v
+        # the SANITIZED ids win over whatever the client sent
+        fwd_headers["X-Request-Id"] = rid
+        fwd_headers["X-Trace-Id"] = tid
         timeout = self._forward_timeout(fwd_headers)
+        t_route = time.monotonic()
+
+        def note_route(replica_id: int, code: int) -> None:
+            # the router half of the cross-process handshake pair the
+            # timeline aligns (its `route` slice spans the forward; the
+            # replica's request_span rides inside it)
+            if span_sampled(rid, 1):
+                flight.record("route", request_id=rid, trace_id=tid,
+                              replica=replica_id, code=int(code),
+                              seconds=time.monotonic() - t_route)
+
         tried: set = set()
         with self._lock:
             n_live = max(1, len(self._replicas))
@@ -1043,10 +1093,11 @@ class ServingPool:
                             req, timeout=timeout) as resp:
                         payload = resp.read()
                         self._note_success(h)
+                        note_route(h.id, resp.status)
                         return (resp.status, payload,
                                 resp.headers.get("Content-Type"),
                                 resp.headers.get("Retry-After"),
-                                {"X-Request-Id": rid,
+                                {"X-Request-Id": rid, "X-Trace-Id": tid,
                                  "X-Replica": str(h.id)})
                 except urllib.error.HTTPError as e:
                     payload = e.read()
@@ -1069,10 +1120,12 @@ class ServingPool:
                         continue
                     else:
                         self._note_success(h)
+                    note_route(h.id, e.code)
                     return (e.code, payload,
                             e.headers.get("Content-Type") if e.headers else None,
                             e.headers.get("Retry-After") if e.headers else None,
-                            {"X-Request-Id": rid, "X-Replica": str(h.id)})
+                            {"X-Request-Id": rid, "X-Trace-Id": tid,
+                             "X-Replica": str(h.id)})
                 except (urllib.error.URLError, OSError,
                         http.client.HTTPException) as e:
                     # connection-level failure: the replica may be dying —
@@ -1090,7 +1143,8 @@ class ServingPool:
         reason = reason or ("pool not ready (no dispatchable replica)")
         return (503, json.dumps({"error": reason,
                                  "request_id": rid}).encode(),
-                "application/json", RETRY_AFTER_S, {"X-Request-Id": rid})
+                "application/json", RETRY_AFTER_S,
+                {"X-Request-Id": rid, "X-Trace-Id": tid})
 
 
 # ------------------------------------------------------------- autoscaler

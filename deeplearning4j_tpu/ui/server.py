@@ -146,6 +146,8 @@ class _Handler(BaseHTTPRequestHandler):
     history_ring = None  # HistoryRing → /history (sampled per request)
     history_dir = None  # history-spool dir merged into /history at read time
     slo_tracker = None  # SloTracker → /slo evaluates at request time
+    timeline_flight_dirs = ()  # flight-spool dirs merged by /debug/timeline
+    timeline_optrace_dirs = ()  # OpProfiler-spool dirs for /debug/timeline
 
     def log_message(self, *args):
         pass
@@ -286,6 +288,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"slos": rows,
                         "violating": [r["slo"] for r in rows
                                       if r["state"] == "violating"]})
+            return
+        if self.path == "/debug/timeline":
+            # fleet timeline (ISSUE 16): every attached flight/op-trace
+            # spool merged at request time into ONE skew-corrected
+            # chrome-trace JSON — save the response and drop it straight
+            # into https://ui.perfetto.dev
+            if not self.timeline_flight_dirs and not self.timeline_optrace_dirs:
+                self._json({"error": "no spool dirs attached — "
+                                     "UIServer.attach_timeline(flight_dirs="
+                                     "[...])"}, 404)
+                return
+            from ..monitoring import timeline as _timeline
+
+            self._json(_timeline.build_timeline(
+                flight_dirs=self.timeline_flight_dirs,
+                optrace_dirs=self.timeline_optrace_dirs,
+                registry=self.registry))
             return
         if self.path == "/sessions":
             self._json(self.storage.session_ids())
@@ -596,6 +615,25 @@ class UIServer:
         handler.slo_tracker = tracker
 
     attachSlo = attach_slo
+
+    def attach_timeline(self, flight_dirs=(), optrace_dirs=()) -> None:
+        """Serve the merged fleet timeline at ``/debug/timeline`` (ISSUE
+        16): every flight-event spool under ``flight_dirs`` (e.g. a
+        ``GangSupervisor.flight_dir`` or ``ServingPool.flight_dir``) plus
+        every ``OpProfiler`` spool under ``optrace_dirs``, skew-corrected
+        onto one wall axis and emitted as Perfetto-loadable chrome-trace
+        JSON, rebuilt per request so it is always current."""
+        if self._httpd is None:
+            self._start(self._storages[0] if self._storages else StatsStorage())
+        handler = self._httpd.RequestHandlerClass
+        if isinstance(flight_dirs, str):
+            flight_dirs = (flight_dirs,)
+        if isinstance(optrace_dirs, str):
+            optrace_dirs = (optrace_dirs,)
+        handler.timeline_flight_dirs = tuple(flight_dirs)
+        handler.timeline_optrace_dirs = tuple(optrace_dirs)
+
+    attachTimeline = attach_timeline
 
     def attach_model(self, net) -> None:
         """Populate the model tab (C14 model-graph tier): /train/model and
