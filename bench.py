@@ -186,6 +186,17 @@ def _scale(on_tpu):
                                    classes=8, queue=256, train_steps=30,
                                    train_batch=256, train_features=256,
                                    train_hidden=512),
+            # few requests x long generations packed into a burst: the
+            # replay measures decode DRAIN speed, not the arrival schedule
+            "paged_decode": dict(d_model=256, n_layers=6, n_heads=8,
+                                 d_ff=1024, vocab=8192, max_len=512,
+                                 block_T=32, slots_dense=4, paged_slots=32,
+                                 short_len=40, cap_prefix_len=224,
+                                 cap_suffix_len=16, cap_max_new=16,
+                                 max_new=384, draft_layers=1, spec_tokens=5,
+                                 duration_s=0.3, base_rate=110.0, clients=32,
+                                 prefix_tenants=4, prefix_len=96,
+                                 suffix_len=16, queue=512),
         }
     return {
         "resnet50": dict(batch=8, hw=64, classes=10, steps=5, warmup=2, pipeline_steps=3),
@@ -219,6 +230,17 @@ def _scale(on_tpu):
                                batch_limit=8, features=16, classes=4,
                                queue=64, train_steps=6, train_batch=32,
                                train_features=32, train_hidden=64),
+        # few requests x long generations packed into a burst: the replay
+        # measures decode DRAIN speed, not the arrival schedule or prefill
+        "paged_decode": dict(d_model=64, n_layers=6, n_heads=4, d_ff=128,
+                             vocab=256, max_len=256, block_T=16,
+                             slots_dense=2, paged_slots=16,
+                             short_len=24, cap_prefix_len=112,
+                             cap_suffix_len=8, cap_max_new=8, max_new=192,
+                             draft_layers=1, spec_tokens=7,
+                             duration_s=0.2, base_rate=60.0, clients=16,
+                             prefix_tenants=2, prefix_len=48, suffix_len=8,
+                             queue=256),
     }
 
 
@@ -1355,6 +1377,164 @@ def bench_serving_pool(p):
     }
 
 
+# ------------------------------------------------------------- paged decoding
+
+
+def _count_admissions(pool, prompts, max_new):
+    """Concurrent sequences a pool holds at once: admit until the first
+    refusal (no slot / no blocks), then release everything. Residency is
+    priced at admission (a paged pool reserves the FULL span in blocks up
+    front), so no decode steps are needed to measure capacity."""
+    admitted = []
+    for toks in prompts:
+        try:
+            slot, _ = pool.admit(np.asarray(toks, np.int32), max_new)
+        except Exception:
+            break
+        admitted.append(slot)
+    for s in admitted:
+        pool.release(s)
+    return len(admitted)
+
+
+def bench_paged_decode(p):
+    """ISSUE 17: the paged-KV + speculative-decoding evidence, in two phases.
+
+    Phase 1 — capacity at equal HBM: a dense per-slot pool and a block-paged
+    pool get the SAME arena budget (``slots_dense * max_len`` positions;
+    the paged pool spends it as ``block_T``-sized blocks plus one trash
+    block). Concurrent residency is counted twice: short unique prompts
+    (paging wins by not padding every sequence to max_len) and long
+    shared-prefix prompts (copy-on-write prefix sharing stacks tenants onto
+    one physical prefix). The acceptance claim is >=3x concurrent
+    long-context sequences.
+
+    Phase 2 — speculative vs plain decode through the generative executor:
+    the same seeded shared-prefix trace (the TraceSpec tenant mix) replayed
+    into a paged pool twice, plain and with a draft model proposing
+    ``spec_tokens`` per target step. The draft here is the target's first
+    ``draft_layers`` layers and the target's tail layers are zeroed into
+    identity (pre-LN residual: ``out_w``/``ffn_w2`` = 0 makes a block a
+    no-op), so draft and target argmax agree by construction — acceptance
+    ~1.0, the best case that bounds the machinery's speedup. Acceptance
+    rate is reported alongside; the claim is >=1.5x tokens/s at a p99 no
+    worse."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import transformer as tfm
+    from deeplearning4j_tpu.serving import (GenerativeInferenceExecutor,
+                                            TraceSpec)
+
+    cfg = _pool_transformer_cfg(p)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    bT, max_new = p["block_T"], p["max_new"]
+    n_blocks = 1 + p["slots_dense"] * (p["max_len"] // bT)  # equal HBM
+
+    # ---- phase 1: dense vs paged capacity at equal HBM -------------------
+    rng = np.random.default_rng(11)
+    n_try = n_blocks + 4
+    short = [rng.integers(1, p["vocab"], size=p["short_len"]).tolist()
+             for _ in range(n_try)]
+    prefixes = [rng.integers(1, p["vocab"], size=p["cap_prefix_len"]).tolist()
+                for _ in range(p["prefix_tenants"])]
+    shared = [prefixes[i % p["prefix_tenants"]]
+              + rng.integers(1, p["vocab"], size=p["cap_suffix_len"]).tolist()
+              for i in range(n_try)]
+
+    cap_new = p["cap_max_new"]
+    dense_pool = tfm.DecodeSlotPool(params, cfg, slots=p["slots_dense"])
+    dense_short = _count_admissions(dense_pool, short, cap_new)
+    dense_long = _count_admissions(dense_pool, shared, cap_new)
+
+    # slots = usable blocks so BLOCKS (HBM), not slot-table rows, bind
+    paged_pool = tfm.PagedDecodeSlotPool(
+        params, cfg, slots=n_blocks - 1, block_T=bT, n_blocks=n_blocks)
+    paged_short = _count_admissions(paged_pool, short, cap_new)
+    paged_long = _count_admissions(paged_pool, shared, cap_new)
+    capacity = {
+        "hbm_positions": p["slots_dense"] * p["max_len"],
+        "blocks_usable": n_blocks - 1, "block_T": bT,
+        "dense_short": dense_short, "paged_short": paged_short,
+        "dense_shared_prefix": dense_long, "paged_shared_prefix": paged_long,
+        "gain_short": (round(paged_short / dense_short, 2)
+                       if dense_short else None),
+        "gain_shared_prefix": (round(paged_long / dense_long, 2)
+                               if dense_long else None),
+    }
+
+    # ---- phase 2: plain vs speculative through the executor --------------
+    # identity-tail target: layers >= draft_layers become exact no-ops, so
+    # the first-draft_layers draft predicts the target's argmax exactly
+    Ld = p["draft_layers"]
+    for blk in params["blocks"][Ld:]:
+        blk["out_w"] = jnp.zeros_like(blk["out_w"])
+        blk["ffn_w2"] = jnp.zeros_like(blk["ffn_w2"])
+    draft_cfg = dataclasses.replace(cfg, n_layers=Ld)
+    draft_params = {"embed": params["embed"], "mlm": params["mlm"],
+                    "blocks": params["blocks"][:Ld]}
+
+    dur = p["duration_s"]
+    spec = TraceSpec(duration_s=dur, base_rate=p["base_rate"], seed=3,
+                     diurnal_amplitude=0.3,
+                     bursts=((0.5 * dur, 0.2 * dur, 4.0),),
+                     prefix_tenants=p["prefix_tenants"],
+                     prefix_len=p["prefix_len"], suffix_len=p["suffix_len"],
+                     prompt_vocab=p["vocab"])
+    prompt_fn = spec.prompt_fn()
+
+    phase2 = {}
+    for mode in ("plain", "speculative"):
+        kw = {}
+        if mode == "speculative":
+            kw = dict(draft_params=draft_params, draft_cfg=draft_cfg,
+                      spec_tokens=p["spec_tokens"])
+        pool = tfm.PagedDecodeSlotPool(
+            params, cfg, slots=p["paged_slots"], block_T=bT, **kw)
+        ex = GenerativeInferenceExecutor(
+            pool, continuous=True, max_queue=p["queue"],
+            default_max_new_tokens=max_new,
+            warmup_prompt=np.asarray([1, 2, 3], np.int32)).start()
+        ex.wait_warm(300.0)
+        try:
+            report = _replay_generative_executor(
+                ex, spec, prompt_fn, lambda i: max_new, p["clients"])
+        finally:
+            ex.stop(drain=True)
+        stats = ex.stats()
+        report["tokens_per_s"] = (
+            round(stats["tokens"] / report["elapsed_s"], 1)
+            if report["elapsed_s"] else 0.0)
+        report["decode_steps"] = stats["steps"]
+        report["block_occupancy"] = stats.get("block_occupancy")
+        report["spec_acceptance"] = stats.get("spec_acceptance")
+        report["cow_shared_blocks"] = (
+            (stats.get("blocks") or {}).get("cow_shared_blocks"))
+        phase2[mode] = report
+
+    plain, spv = phase2["plain"], phase2["speculative"]
+    speedup = (round(spv["tokens_per_s"] / plain["tokens_per_s"], 2)
+               if plain["tokens_per_s"] else None)
+    p99_ratio = (round(plain["p99_ms"] / spv["p99_ms"], 2)
+                 if spv.get("p99_ms") and plain.get("p99_ms") else None)
+
+    return {
+        "metric": "paged_decode_spec_tokens_per_sec",
+        "value": spv["tokens_per_s"],
+        "unit": "tokens/s",
+        "capacity": capacity,
+        "plain": plain,
+        "speculative": spv,
+        # acceptance pair: speedup >= 1.5 at plain_over_spec_p99 >= 1.0
+        "spec_speedup": speedup,
+        "plain_over_spec_p99": p99_ratio,
+        "spec_tokens": p["spec_tokens"], "draft_layers": Ld,
+        "trace": spec.to_dict(),
+    }
+
+
 # --------------------------------------------------------------------- driver
 
 
@@ -2053,7 +2233,8 @@ BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "reshard": bench_reshard,
            "ckpt_lineage": bench_ckpt_lineage,
            "compile_cache": bench_compile_cache,
-           "trace_overhead": bench_trace_overhead}
+           "trace_overhead": bench_trace_overhead,
+           "paged_decode": bench_paged_decode}
 
 
 # -------------------------------------------------------- regression compare

@@ -1,0 +1,733 @@
+"""Block-paged KV cache with CoW prefix sharing and speculative decoding.
+
+PR 12's :class:`~.transformer.DecodeSlotPool` provisions a dense
+``[L, slots, maxT, H, hd]`` cache — every slot pays worst-case HBM whether
+its sequence is 12 tokens or 500.  This module replaces that storage with a
+vLLM-shape paged arena behind the SAME one-signature decode step:
+
+- **arena** — K/V live in ``[L, n_blocks, block_T, H, hd]``; block 0 is a
+  scratch ("trash") block that absorbs writes from dead slots and from
+  prefill positions that belong to a shared block, so the jitted step never
+  branches on liveness;
+- **block tables** — each slot owns a ``[max_blocks]`` int32 row mapping
+  logical block -> physical block (0 = unmapped/trash).  The decode math
+  reaches its keys via ``arena[tables]`` — a gather that reproduces the
+  dense logical layout ``[S, max_len, H, hd]``, after which the einsum /
+  mask / softmax are byte-for-byte the dense pool's.  Tables change every
+  admission; shapes never do, so ``decode_traces`` still pins to 1 under
+  admit/retire/alloc churn;
+- **copy-on-write prefix sharing** — an exact-match index (keyed on the
+  literal prompt token bytes — no hash-collision wrongness) maps full
+  prompt-prefix blocks and partial prompt tails to physical blocks.  An
+  admission that matches takes a refcount instead of recomputing prefill
+  for those blocks; a sharer that must WRITE into a joined partial block
+  first copies it into a block reserved for exactly that purpose at
+  admission time (so CoW can never fail mid-decode);
+- **block-priced admission** — ``admit`` prices a request as
+  ``ceil((prompt + max_new [+ spec slack]) / block_T)`` blocks minus what
+  the prefix index already holds, and raises :class:`NoFreeBlocksError`
+  (``retry_admission = True``) when the arena cannot hold it NOW — the
+  serving executor re-queues instead of failing the request;
+- **speculative decoding** — with a small draft model from the same zoo, one
+  jitted step drafts ``k`` greedy tokens (k+1 chained single-token passes
+  over the draft's own paged arena, sharing the block tables) and verifies
+  them in ONE batched target forward over the (k+1)-token window.  Greedy
+  acceptance (``n_acc = 1 + cumprod(match).sum()``) makes the emitted
+  stream token-identical to plain greedy decoding by construction; rejected
+  positions hold stale K/V that the sequential write-before-read discipline
+  overwrites before it is ever attended.
+
+Single-owner object like the dense pool: the decode loop thread (or the
+offline ``generate`` driver) is the only caller — no internal locking.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import (
+    TransformerConfig,
+    KvCacheLostError,
+    _layer_norm,
+    _NEG_INF,
+    mlm_head,
+    prefill_forward,
+)
+
+
+class NoFreeBlocksError(RuntimeError):
+    """The paged arena cannot hold this admission RIGHT NOW (it would fit an
+    empty arena — unsatisfiable-ever requests are a ``ValueError`` instead).
+    ``retry_admission`` is the duck-typed marker the serving executor keys
+    on to re-queue the request at the head of the line rather than fail it."""
+
+    retry_admission = True
+
+
+class BlockAllocator:
+    """Refcounted free-list allocator over the arena's physical blocks.
+
+    Block 0 (trash) is never handed out.  ``reserved`` blocks are held back
+    from admission so an already-admitted sharer's copy-on-write can never
+    fail; a reserve is consumed by decrementing ``reserved`` before
+    ``alloc``.  The prefix index lives here too so that a block's index
+    keys die with its last reference."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 usable + trash), got {n_blocks}")
+        self.n_blocks = n_blocks
+        self._free: List[int] = list(range(1, n_blocks))  # block 0 = trash
+        self.refcount = np.zeros(n_blocks, np.int32)
+        self.reserved = 0
+        self._index: Dict[Any, int] = {}     # prefix key -> physical block
+        self._keys_of: Dict[int, list] = {}  # physical block -> [keys]
+
+    @property
+    def free_blocks(self) -> int:
+        """Blocks available to NEW admissions (CoW reserves held back)."""
+        return len(self._free) - self.reserved
+
+    def alloc(self, count: int) -> List[int]:
+        if count > self.free_blocks:
+            raise NoFreeBlocksError(
+                f"{count} KV blocks needed, {self.free_blocks} free "
+                f"({self.reserved} reserved for copy-on-write)")
+        out = [self._free.pop(0) for _ in range(count)]
+        for b in out:
+            self.refcount[b] = 1
+        return out
+
+    def ref(self, block: int) -> None:
+        self.refcount[block] += 1
+
+    def unref(self, block: int) -> None:
+        self.refcount[block] -= 1
+        if self.refcount[block] <= 0:
+            self.refcount[block] = 0
+            for key in self._keys_of.pop(block, ()):
+                if self._index.get(key) == block:
+                    del self._index[key]
+            self._free.append(block)
+
+    def register(self, key, block: int) -> None:
+        """Publish ``block`` under ``key`` in the prefix index (first
+        registration wins — identical later prompts share instead)."""
+        if key not in self._index:
+            self._index[key] = block
+            self._keys_of.setdefault(block, []).append(key)
+
+    def lookup(self, key) -> Optional[int]:
+        return self._index.get(key)
+
+
+def _embed_window(params, cfg: TransformerConfig, tokens, positions):
+    """Decode-step embedding at explicit positions: [S,W] -> [S,W,D]."""
+    e = params["embed"]
+    h = e["tok"][tokens] + e["pos"][positions]
+    if cfg.type_vocab > 0:
+        h = h + e["seg"][0]
+    return _layer_norm(h, e["ln_scale"], e["ln_bias"]).astype(cfg.compute_dtype)
+
+
+def _paged_window_block(cfg: TransformerConfig, p, h, kf, vf, tables, cells,
+                        kv_mask, n_blocks: int, block_T: int):
+    """One transformer block over a W-token decode window with paged K/V.
+
+    h [S,W,D]; kf/vf [n_blocks*block_T, H, hd] (this layer's FLAT arena);
+    tables [S, max_blocks] logical->physical; cells [S,W] flat arena cells
+    where this window's K/V land; kv_mask [S,W,max_len] over LOGICAL key
+    positions.  The gather ``arena[tables]`` rebuilds the dense logical
+    ``[S, max_len, H, hd]`` view, so everything after it — scale, mask
+    constant, softmax, dtype discipline — mirrors the dense
+    ``_decode_block`` exactly.  Returns (h, new_kf, new_vf)."""
+    S, W, D = h.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    cd = cfg.compute_dtype
+    scale = 1.0 / math.sqrt(hd)
+    written = {}
+
+    def attn_sub(x):
+        qkv = x @ p["qkv_w"].astype(cd) + p["qkv_b"].astype(cd)
+        q, k, v = (t.reshape(S, W, H, hd) for t in jnp.split(qkv, 3, axis=-1))
+        # write-before-read: this window's K/V land in their cells first, so
+        # stale/garbage cells at <= attended positions never survive a step
+        nkf = kf.at[cells.reshape(-1)].set(k.reshape(S * W, H, hd).astype(kf.dtype))
+        nvf = vf.at[cells.reshape(-1)].set(v.reshape(S * W, H, hd).astype(vf.dtype))
+        written["k"], written["v"] = nkf, nvf
+        g_k = nkf.reshape(n_blocks, block_T, H, hd)[tables].reshape(S, -1, H, hd)
+        g_v = nvf.reshape(n_blocks, block_T, H, hd)[tables].reshape(S, -1, H, hd)
+        scores = jnp.einsum("swhd,sthd->swht", q, g_k.astype(cd)) * scale
+        scores = jnp.where(kv_mask[:, :, None, :], scores, _NEG_INF)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("swht,sthd->swhd", w, g_v.astype(cd)).reshape(S, W, D)
+        return o @ p["out_w"].astype(cd) + p["out_b"].astype(cd)
+
+    def ffn_sub(x):
+        x = jax.nn.gelu(x @ p["ffn_w1"].astype(cd) + p["ffn_b1"].astype(cd),
+                        approximate=cfg.gelu_approximate)
+        return x @ p["ffn_w2"].astype(cd) + p["ffn_b2"].astype(cd)
+
+    if cfg.norm_position == "pre":
+        h = h + attn_sub(_layer_norm(h, p["ln1_scale"], p["ln1_bias"]).astype(cd)).astype(h.dtype)
+        h = h + ffn_sub(_layer_norm(h, p["ln2_scale"], p["ln2_bias"]).astype(cd)).astype(h.dtype)
+    else:
+        h = _layer_norm(h + attn_sub(h.astype(cd)).astype(h.dtype),
+                        p["ln1_scale"], p["ln1_bias"]).astype(h.dtype)
+        h = _layer_norm(h + ffn_sub(h.astype(cd)).astype(h.dtype),
+                        p["ln2_scale"], p["ln2_bias"]).astype(h.dtype)
+    return h, written["k"], written["v"]
+
+
+def _paged_forward(params, cfg: TransformerConfig, tokens, positions, kfs, vfs,
+                   tables, n_blocks: int, block_T: int):
+    """Full-model W-token decode window over flat per-layer arenas.
+
+    tokens/positions [S,W]; kfs/vfs: python lists of per-layer flat arenas
+    (functional update — returns new lists).  Returns
+    (logits [S,W,V] fp32, new_kfs, new_vfs)."""
+    max_len = tables.shape[1] * block_T
+    h = _embed_window(params, cfg, tokens, positions)
+    lb = positions // block_T
+    phys = jnp.take_along_axis(tables, lb, axis=1)
+    cells = phys * block_T + positions % block_T
+    kv_mask = jnp.arange(max_len)[None, None, :] <= positions[:, :, None]
+    new_k, new_v = [], []
+    for l in range(cfg.n_layers):
+        h, k_l, v_l = _paged_window_block(
+            cfg, params["blocks"][l], h, kfs[l], vfs[l], tables, cells,
+            kv_mask, n_blocks, block_T)
+        new_k.append(k_l)
+        new_v.append(v_l)
+    return mlm_head(params, h, cfg), new_k, new_v
+
+
+class PagedDecodeSlotPool:
+    """Drop-in paged replacement for the dense ``DecodeSlotPool``.
+
+    Same duck interface (``admit``/``step``/``release``, ``free_slots``,
+    ``prompt_bucket``, trace counters, ``KvCacheLostError`` reset) with
+    three additions the serving executor discovers by ``getattr``:
+
+    - ``can_admit``/``request_blocks``/``total_blocks`` — block-priced
+      admission control (queue-head gating and at-the-door 400s);
+    - ``block_stats()`` — occupancy, CoW sharing and speculative counters
+      for ``stats()``/telemetry;
+    - multi-token steps: ``step()`` returns ``{slot: [tokens...]}`` (one
+      token per step plain, up to ``spec_tokens + 1`` speculative), each
+      list clamped to the slot's remaining ``max_new_tokens`` budget.
+
+    Pass ``draft_params``/``draft_cfg`` (a smaller config from the same
+    zoo — same vocab, causal) to enable speculative decoding with
+    ``spec_tokens`` drafted per target step.
+    """
+
+    def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
+                 block_T: int = 16, n_blocks: Optional[int] = None,
+                 max_len: Optional[int] = None, eos_id: Optional[int] = None,
+                 min_prompt_bucket: int = 16,
+                 draft_params=None, draft_cfg: Optional[TransformerConfig] = None,
+                 spec_tokens: int = 4):
+        if not cfg.causal:
+            raise ValueError(
+                "autoregressive decode needs a causal config "
+                "(TransformerConfig(causal=True)) — a bidirectional encoder "
+                "cannot extend a sequence incrementally")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if block_T < 1 or (block_T & (block_T - 1)):
+            raise ValueError(f"block_T must be a power of two, got {block_T}")
+        self.max_len = max_len or cfg.max_len
+        if self.max_len > cfg.max_len:
+            raise ValueError(f"max_len {self.max_len} exceeds the model's "
+                             f"positional range max_len={cfg.max_len}")
+        if self.max_len % block_T:
+            raise ValueError(f"max_len {self.max_len} must be a multiple of "
+                             f"block_T {block_T}")
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError("speculative decoding needs BOTH draft_params "
+                             "and draft_cfg (or neither)")
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.block_T = block_T
+        self.eos_id = eos_id
+        self.max_blocks = self.max_len // block_T  # logical blocks per slot
+        self.n_blocks = n_blocks or (1 + slots * self.max_blocks)
+        if self.n_blocks < 2:
+            raise ValueError("n_blocks must be >= 2 (1 usable + trash)")
+        # bucket sizes must stay block-aligned so prefill scatter is whole blocks
+        self.min_prompt_bucket = max(1, min_prompt_bucket, block_T)
+
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_tokens = int(spec_tokens) if draft_cfg is not None else 0
+        if draft_cfg is not None:
+            if self.spec_tokens < 1:
+                raise ValueError(f"spec_tokens must be >= 1, got {spec_tokens}")
+            if not draft_cfg.causal:
+                raise ValueError("draft model must be causal")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size} — greedy verify compares token ids")
+            if draft_cfg.max_len < self.max_len:
+                raise ValueError(
+                    f"draft positional range {draft_cfg.max_len} < pool "
+                    f"max_len {self.max_len}")
+
+        self._alloc = BlockAllocator(self.n_blocks)
+        self._kc, self._vc = self._new_arena(cfg)
+        self._dkc, self._dvc = (self._new_arena(draft_cfg)
+                                if draft_cfg is not None else (None, None))
+        self._tables = np.zeros((slots, self.max_blocks), np.int32)
+        self._active = np.zeros(slots, bool)
+        self._positions = np.zeros(slots, np.int32)
+        self._tokens = np.zeros(slots, np.int32)
+        self._budget = np.zeros(slots, np.int32)    # max_new_tokens per slot
+        self._emitted = np.zeros(slots, np.int32)   # tokens handed to caller
+        self._span = np.zeros(slots, np.int32)      # reserved position span
+        self._nblocks = np.zeros(slots, np.int32)   # logical blocks owned
+        self._cow_reserve = np.zeros(slots, np.int32)
+        self._joined: Dict[int, Dict[int, int]] = {}  # slot -> {logical: phys}
+        # cumulative speculative counters (0 forever on a plain pool)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        # python-side trace counters: incremented when jax TRACES (not runs)
+        # the fns — tests pin "one decode signature under membership churn"
+        self.decode_traces = 0
+        self.prefill_traces = 0
+
+        NB, bT = self.n_blocks, self.block_T
+        spec = draft_cfg is not None
+        k = self.spec_tokens
+
+        def _flat(kc):
+            return [kc[l].reshape(NB * bT, kc.shape[3], kc.shape[4])
+                    for l in range(kc.shape[0])]
+
+        def _stack(flats, H, hd):
+            return jnp.stack([f.reshape(NB, bT, H, hd) for f in flats])
+
+        def _decode(params, kc, vc, tables, tokens, positions):
+            self.decode_traces += 1
+            logits, nk, nv = _paged_forward(
+                params, cfg, tokens[:, None], positions[:, None],
+                _flat(kc), _flat(vc), tables, NB, bT)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            return (_stack(nk, cfg.n_heads, cfg.head_dim),
+                    _stack(nv, cfg.n_heads, cfg.head_dim), nxt)
+
+        def _spec(params, dparams, kc, vc, dkc, dvc, tables, tokens, positions):
+            self.decode_traces += 1
+            dkf, dvf = _flat(dkc), _flat(dvc)
+            # --- draft phase: k+1 chained single-token passes.  Pass j
+            # consumes window[j] at position p+j; passes 0..k-1 propose
+            # d_1..d_k; pass k only WRITES draft K/V at p+k so a fully
+            # accepted round leaves no hole in the draft cache.
+            window = [tokens]
+            for j in range(k + 1):
+                pos_j = (positions + j)[:, None]
+                logits, dkf, dvf = _paged_forward(
+                    dparams, draft_cfg, window[j][:, None], pos_j,
+                    dkf, dvf, tables, NB, bT)
+                if j < k:
+                    window.append(
+                        jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32))
+            win = jnp.stack(window, axis=1)                      # [S, k+1]
+            pos_w = positions[:, None] + jnp.arange(k + 1)[None, :]
+            # --- verify phase: ONE batched target forward over the window
+            logits, nk, nv = _paged_forward(
+                params, cfg, win, pos_w, _flat(kc), _flat(vc), tables, NB, bT)
+            ver = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
+            # greedy acceptance: d_i accepted while it matches the target's
+            # own greedy continuation; emitted tokens are ver[:, :n_acc]
+            m = (win[:, 1:] == ver[:, :-1]).astype(jnp.int32)
+            n_acc = 1 + jnp.cumprod(m, axis=1).sum(axis=1)
+            return (_stack(nk, cfg.n_heads, cfg.head_dim),
+                    _stack(nv, cfg.n_heads, cfg.head_dim),
+                    _stack(dkf, draft_cfg.n_heads, draft_cfg.head_dim),
+                    _stack(dvf, draft_cfg.n_heads, draft_cfg.head_dim),
+                    ver, n_acc.astype(jnp.int32))
+
+        def _prefill_blocked(ks):
+            # [L, 1, H, Tb, hd] -> [L, Tb//bT, bT, H, hd] for the arena layout
+            x = jnp.transpose(ks[:, 0], (0, 2, 1, 3))
+            L_, Tb, H_, hd_ = x.shape
+            return x.reshape(L_, Tb // bT, bT, H_, hd_)
+
+        def _prefill(params, kc, vc, dest_blocks, tokens, length):
+            self.prefill_traces += 1
+            h, ks, vs = prefill_forward(params, tokens, cfg)
+            kc = kc.at[:, dest_blocks].set(_prefill_blocked(ks).astype(kc.dtype))
+            vc = vc.at[:, dest_blocks].set(_prefill_blocked(vs).astype(vc.dtype))
+            last = h[0, length - 1]
+            logits = mlm_head(params, last[None], cfg)[0]
+            return kc, vc, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def _prefill_spec(params, dparams, kc, vc, dkc, dvc, dest_blocks,
+                          tokens, length):
+            self.prefill_traces += 1
+            h, ks, vs = prefill_forward(params, tokens, cfg)
+            kc = kc.at[:, dest_blocks].set(_prefill_blocked(ks).astype(kc.dtype))
+            vc = vc.at[:, dest_blocks].set(_prefill_blocked(vs).astype(vc.dtype))
+            _, dks, dvs = prefill_forward(dparams, tokens, draft_cfg)
+            dkc = dkc.at[:, dest_blocks].set(_prefill_blocked(dks).astype(dkc.dtype))
+            dvc = dvc.at[:, dest_blocks].set(_prefill_blocked(dvs).astype(dvc.dtype))
+            last = h[0, length - 1]
+            logits = mlm_head(params, last[None], cfg)[0]
+            return kc, vc, dkc, dvc, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def _copy(kc, vc, src, dst):
+            kc = kc.at[:, dst].set(kc[:, src])
+            vc = vc.at[:, dst].set(vc[:, src])
+            return kc, vc
+
+        def _copy_spec(kc, vc, dkc, dvc, src, dst):
+            kc = kc.at[:, dst].set(kc[:, src])
+            vc = vc.at[:, dst].set(vc[:, src])
+            dkc = dkc.at[:, dst].set(dkc[:, src])
+            dvc = dvc.at[:, dst].set(dvc[:, src])
+            return kc, vc, dkc, dvc
+
+        # arena buffers are donated: steps update them in place instead of
+        # holding two live copies of the pool's largest allocation
+        if spec:
+            self._decode_fn = jax.jit(_spec, donate_argnums=(2, 3, 4, 5))
+            self._prefill_fn = jax.jit(_prefill_spec, donate_argnums=(2, 3, 4, 5))
+            self._copy_fn = jax.jit(_copy_spec, donate_argnums=(0, 1, 2, 3))
+        else:
+            self._decode_fn = jax.jit(_decode, donate_argnums=(1, 2))
+            self._prefill_fn = jax.jit(_prefill, donate_argnums=(1, 2))
+            self._copy_fn = jax.jit(_copy, donate_argnums=(0, 1))
+
+    def _new_arena(self, cfg: TransformerConfig):
+        shape = (cfg.n_layers, self.n_blocks, self.block_T,
+                 cfg.n_heads, cfg.head_dim)
+        return (jnp.zeros(shape, cfg.compute_dtype),
+                jnp.zeros(shape, cfg.compute_dtype))
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def vocab_size(self) -> int:
+        return self.cfg.vocab_size
+
+    @property
+    def free_slots(self) -> int:
+        return int(self.slots - self._active.sum())
+
+    @property
+    def occupancy(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def total_blocks(self) -> int:
+        """Usable arena blocks (trash block excluded) — the capacity an
+        admission's worst-case block price is checked against at the door."""
+        return self.n_blocks - 1
+
+    @property
+    def admit_overhead_tokens(self) -> int:
+        """Extra positions every admission reserves beyond prompt+max_new
+        (speculative lookahead scratch) — the executor adds this to its
+        at-the-door max_len validation."""
+        return self.spec_tokens
+
+    def request_blocks(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case (no sharing) block price of a request."""
+        span = prompt_len + max_new_tokens + self.spec_tokens
+        return -(-span // self.block_T)
+
+    def prompt_bucket(self, n: int) -> int:
+        from ..common.bucketing import bucket_size
+
+        return min(self.max_len, bucket_size(n, min_bucket=self.min_prompt_bucket))
+
+    def block_stats(self) -> Dict[str, int]:
+        """Occupancy / sharing / speculation counters for ``stats()`` and
+        the ``tdl_decode_blocks_*`` + ``tdl_decode_spec_*`` families."""
+        rc = self._alloc.refcount[1:]  # trash block is bookkeeping, not capacity
+        return {
+            "blocks_total": self.total_blocks,
+            "blocks_free": self._alloc.free_blocks,
+            "cow_shared_blocks": int((rc > 1).sum()),
+            "cow_saved_blocks": int(np.maximum(rc - 1, 0).sum()),
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+        }
+
+    # -- admission planning ------------------------------------------------
+
+    def _plan(self, toks: np.ndarray, max_new_tokens: int):
+        """Price an admission: (span, nblocks, shared_full, tail_block,
+        new_needed, reserve_needed).  Raises ValueError for never-fits."""
+        n = toks.shape[0]
+        if n < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        span = n + max_new_tokens + self.spec_tokens
+        if span > self.max_len:
+            slack = (f" + {self.spec_tokens} speculative slack"
+                     if self.spec_tokens else "")
+            raise ValueError(
+                f"prompt of {n} tokens + {max_new_tokens} new tokens{slack} "
+                f"exceeds the {self.max_len}-position KV cache")
+        bT = self.block_T
+        nblocks = -(-span // bT)
+        fb = n // bT
+        shared_full: List[int] = []
+        for i in range(fb):
+            b = self._alloc.lookup(("full", toks[:(i + 1) * bT].tobytes()))
+            if b is None:
+                break
+            shared_full.append(b)
+        tail = None
+        if len(shared_full) == fb and n % bT:
+            tail = self._alloc.lookup(("tail", toks.tobytes()))
+        new_needed = nblocks - len(shared_full) - (0 if tail is None else 1)
+        reserve = 0 if tail is None else 1
+        return span, nblocks, shared_full, tail, new_needed, reserve
+
+    def can_admit(self, prompt, max_new_tokens: int = 1) -> bool:
+        """Dry-run admission check (slot + blocks, prefix sharing counted)
+        — the executor's queue-head gate.  False means 'not NOW'; a
+        never-fits request raises the same ValueError ``admit`` would."""
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        _, _, _, _, new_needed, reserve = self._plan(toks, max_new_tokens)
+        if not (~self._active).any():
+            return False
+        return self._alloc.free_blocks >= new_needed + reserve
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def admit(self, prompt, max_new_tokens: int = 1):
+        """Prefill ``prompt`` into a free slot, paying only for blocks the
+        prefix index does not already hold.  Returns ``(slot, first_token)``.
+        Raises ``ValueError`` (never fits), ``RuntimeError`` (no free slot),
+        :class:`NoFreeBlocksError` (no blocks NOW — re-queueable), or
+        ``KvCacheLostError`` (donated prefill failed; pool already reset)."""
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        n = toks.shape[0]
+        span, nblocks, shared_full, tail, new_needed, reserve = \
+            self._plan(toks, max_new_tokens)
+        free = np.flatnonzero(~self._active)
+        if free.size == 0:
+            raise RuntimeError("no free decode slot")
+        if self._alloc.free_blocks < new_needed + reserve:
+            raise NoFreeBlocksError(
+                f"admission needs {new_needed} new KV blocks"
+                f"{f' (+{reserve} CoW reserve)' if reserve else ''} but only "
+                f"{self._alloc.free_blocks} of {self.total_blocks} are free")
+        slot = int(free[0])
+        bT = self.block_T
+        fb = n // bT
+
+        new_blocks = self._alloc.alloc(new_needed)
+        for b in shared_full:
+            self._alloc.ref(b)
+        row = np.zeros(self.max_blocks, np.int32)
+        li = 0
+        for b in shared_full:
+            row[li] = b
+            li += 1
+        joined: Dict[int, int] = {}
+        if tail is not None:
+            self._alloc.ref(tail)
+            self._alloc.reserved += 1
+            self._cow_reserve[slot] = 1
+            joined[li] = tail  # logical tail block: copy before first write
+            row[li] = tail
+            li += 1
+        for b in new_blocks:
+            row[li] = b
+            li += 1
+
+        bucket = self.prompt_bucket(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = toks
+        # prefill scatters whole blocks; shared blocks (and the bucket's
+        # padding overshoot past the reservation) are redirected to the
+        # trash block 0 so a sharer's prefill can never clobber live K/V
+        shared_set = set(shared_full) | ({tail} if tail is not None else set())
+        dest = np.zeros(bucket // bT, np.int32)
+        for j in range(bucket // bT):
+            if j < nblocks and row[j] not in shared_set:
+                dest[j] = row[j]
+        try:
+            if self.draft_cfg is not None:
+                self._kc, self._vc, self._dkc, self._dvc, first = \
+                    self._prefill_fn(self.params, self.draft_params,
+                                     self._kc, self._vc, self._dkc, self._dvc,
+                                     dest, padded, np.int32(n))
+            else:
+                self._kc, self._vc, first = self._prefill_fn(
+                    self.params, self._kc, self._vc, dest, padded, np.int32(n))
+        except Exception as e:
+            self._reset_after_failure()
+            raise KvCacheLostError(
+                f"prefill failed after its KV buffers were donated "
+                f"({type(e).__name__}: {e}); cache reset, in-flight "
+                f"sequences lost") from e
+
+        # publish this prompt's freshly WRITTEN blocks for future sharers
+        for i in range(fb):
+            if i >= len(shared_full):
+                self._alloc.register(("full", toks[:(i + 1) * bT].tobytes()),
+                                     int(row[i]))
+        if n % bT and tail is None:
+            self._alloc.register(("tail", toks.tobytes()), int(row[fb]))
+
+        self._tables[slot] = row
+        self._active[slot] = True
+        self._positions[slot] = n
+        self._tokens[slot] = int(first)
+        self._budget[slot] = max_new_tokens
+        self._emitted[slot] = 1
+        self._span[slot] = span
+        self._nblocks[slot] = nblocks
+        self._joined[slot] = joined
+        return slot, int(first)
+
+    def _cow_before_write(self, slot: int, p_lo: int, p_hi: int) -> None:
+        """Copy any JOINED shared block this step will write into (positions
+        p_lo..p_hi inclusive) into the block reserved at admission.  The
+        original registrant keeps writing in place — safe, because every
+        sharer of a tail block has the identical prompt, masks positions
+        >= its length, and copies before its own first write."""
+        bT = self.block_T
+        joined = self._joined.get(slot)
+        if not joined:
+            return
+        for lb in range(p_lo // bT, p_hi // bT + 1):
+            old = joined.pop(lb, None)
+            if old is None:
+                continue
+            if self._cow_reserve[slot] > 0:
+                self._cow_reserve[slot] -= 1
+                self._alloc.reserved -= 1
+            new = self._alloc.alloc(1)[0]
+            try:
+                if self.draft_cfg is not None:
+                    self._kc, self._vc, self._dkc, self._dvc = self._copy_fn(
+                        self._kc, self._vc, self._dkc, self._dvc,
+                        np.int32(old), np.int32(new))
+                else:
+                    self._kc, self._vc = self._copy_fn(
+                        self._kc, self._vc, np.int32(old), np.int32(new))
+            except Exception as e:
+                self._reset_after_failure()
+                raise KvCacheLostError(
+                    f"copy-on-write failed after the arena was donated "
+                    f"({type(e).__name__}: {e}); cache reset, in-flight "
+                    f"sequences lost") from e
+            self._tables[slot, lb] = new
+            self._alloc.unref(old)
+
+    def step(self) -> Dict[int, List[int]]:
+        """Advance EVERY live slot through ONE fixed-signature XLA call.
+
+        Returns ``{slot: [tokens...]}`` — one token plain, up to
+        ``spec_tokens + 1`` speculative, clamped to the slot's remaining
+        ``max_new_tokens`` budget.  The caller decides retirement (EOS /
+        budget / deadline) and calls :meth:`release`."""
+        live = np.flatnonzero(self._active)
+        if live.size == 0:
+            return {}
+        window = self.spec_tokens + 1 if self.draft_cfg is not None else 1
+        if (self._positions[live] + window > self._span[live]).any():
+            raise RuntimeError(
+                "a live slot is at the end of its reserved block span — the "
+                "caller must retire sequences at their token budget")
+        for s in live:
+            s = int(s)
+            self._cow_before_write(s, int(self._positions[s]),
+                                   int(self._positions[s]) + window - 1)
+        tables = jnp.asarray(self._tables)
+        toks = jnp.asarray(self._tokens)
+        pos = jnp.asarray(self._positions)
+        out: Dict[int, List[int]] = {}
+        try:
+            if self.draft_cfg is not None:
+                (self._kc, self._vc, self._dkc, self._dvc, ver, n_acc) = \
+                    self._decode_fn(self.params, self.draft_params,
+                                    self._kc, self._vc, self._dkc, self._dvc,
+                                    tables, toks, pos)
+            else:
+                self._kc, self._vc, nxt = self._decode_fn(
+                    self.params, self._kc, self._vc, tables, toks, pos)
+        except Exception as e:
+            self._reset_after_failure()
+            raise KvCacheLostError(
+                f"decode step failed after its KV buffers were donated "
+                f"({type(e).__name__}: {e}); cache reset, in-flight "
+                f"sequences lost") from e
+        if self.draft_cfg is None:
+            nxt = np.asarray(nxt)
+            for slot in live:
+                slot = int(slot)
+                out[slot] = [int(nxt[slot])]
+                self._positions[slot] += 1
+                self._tokens[slot] = nxt[slot]
+                self._emitted[slot] += 1
+            return out
+        ver = np.asarray(ver)
+        n_acc = np.asarray(n_acc)
+        for slot in live:
+            slot = int(slot)
+            na = int(n_acc[slot])
+            self.spec_proposed += self.spec_tokens
+            self.spec_accepted += na - 1
+            remaining = int(self._budget[slot] - self._emitted[slot])
+            take = min(na, max(remaining, 0))
+            out[slot] = [int(t) for t in ver[slot, :take]]
+            self._positions[slot] += na
+            self._tokens[slot] = int(ver[slot, na - 1])
+            self._emitted[slot] += take
+        return out
+
+    def release(self, slot: int) -> None:
+        """Free a slot: drop its block references (shared blocks survive
+        while other sequences or the prefix index's last holder need them),
+        return any unused CoW reserve, and clear the table row."""
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        for lb in range(int(self._nblocks[slot])):
+            self._alloc.unref(int(self._tables[slot, lb]))
+        self._alloc.reserved -= int(self._cow_reserve[slot])
+        self._cow_reserve[slot] = 0
+        self._tables[slot] = 0
+        self._active[slot] = False
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+        self._budget[slot] = 0
+        self._emitted[slot] = 0
+        self._span[slot] = 0
+        self._nblocks[slot] = 0
+        self._joined.pop(slot, None)
+
+    def _reset_after_failure(self) -> None:
+        """Recover from a failed donated call: fresh zero arenas, fresh
+        allocator (the prefix index dies with the K/V it pointed at), all
+        slots free.  In-flight sequences are lost (the caller tells their
+        riders); the pool itself keeps serving."""
+        self._kc, self._vc = self._new_arena(self.cfg)
+        if self.draft_cfg is not None:
+            self._dkc, self._dvc = self._new_arena(self.draft_cfg)
+        self._alloc = BlockAllocator(self.n_blocks)
+        self._tables[:] = 0
+        self._active[:] = False
+        self._positions[:] = 0
+        self._tokens[:] = 0
+        self._budget[:] = 0
+        self._emitted[:] = 0
+        self._span[:] = 0
+        self._nblocks[:] = 0
+        self._cow_reserve[:] = 0
+        self._joined.clear()

@@ -742,14 +742,23 @@ class DecodeSlotPool:
 def generate(params, prompts, max_new_tokens: int,
              cfg: TransformerConfig, *, slots: Optional[int] = None,
              eos_id: Optional[int] = None, max_len: Optional[int] = None,
-             pool: Optional[DecodeSlotPool] = None):
-    """Greedy batch generation through the slot pool (offline API).
+             pool=None, draft_params=None, draft_cfg=None,
+             spec_tokens: int = 4):
+    """Greedy batch generation through a decode pool (offline API).
 
     ``prompts``: sequence of 1-D int token sequences (ragged ok). Returns a
     list of generated-token lists, one per prompt, each ending at
     ``eos_id`` (inclusive) or ``max_new_tokens``. Admission is continuous:
     a finished sequence's slot is refilled immediately, so a batch of
-    mixed-length generations never pads to its slowest member."""
+    mixed-length generations never pads to its slowest member.
+
+    There is ONE decode implementation: when no ``pool`` is passed the
+    driver builds a block-paged :class:`PagedDecodeSlotPool` (sized to the
+    dense pool's HBM footprint, so existing ``slots=N`` semantics hold);
+    pass ``draft_params``/``draft_cfg`` to decode speculatively — the
+    output is token-identical to plain greedy by construction. A dense
+    ``DecodeSlotPool`` still works via ``pool=``; both step protocols
+    (``{slot: tok}`` and ``{slot: [toks...]}``) are understood."""
     from collections import deque
 
     if max_new_tokens < 1:
@@ -758,17 +767,37 @@ def generate(params, prompts, max_new_tokens: int,
     if not prompts:
         return []
     if pool is None:
-        pool = DecodeSlotPool(params, cfg,
-                              slots=slots or min(8, len(prompts)),
-                              eos_id=eos_id, max_len=max_len)
+        T = max_len or cfg.max_len
+        # the largest power-of-two block size (<= 16) that divides max_len,
+        # so any model's positional range pages cleanly
+        block_T = 16
+        while T % block_T:
+            block_T //= 2
+        pool_cls = globals().get("PagedDecodeSlotPool")
+        if pool_cls is None:
+            pool_cls = __getattr__("PagedDecodeSlotPool")
+        pool = pool_cls(params, cfg,
+                        slots=slots or min(8, len(prompts)),
+                        eos_id=eos_id, max_len=max_len,
+                        block_T=block_T, draft_params=draft_params,
+                        draft_cfg=draft_cfg, spec_tokens=spec_tokens)
     eos = eos_id if eos_id is not None else pool.eos_id
     pending = deque(enumerate(prompts))
     live: Dict[int, list] = {}  # slot -> [prompt index, generated tokens]
     results: Dict[int, list] = {}
     while pending or live:
         while pending and pool.free_slots:
-            idx, prompt = pending.popleft()
-            slot, first = pool.admit(prompt, max_new_tokens)
+            idx, prompt = pending[0]
+            try:
+                slot, first = pool.admit(prompt, max_new_tokens)
+            except Exception as e:
+                # paged pools can be slot-free but block-full; drain the
+                # live sequences and retry (an empty pool would admit, so
+                # with nothing live this can never succeed — re-raise)
+                if getattr(e, "retry_admission", False) and live:
+                    break
+                raise
+            pending.popleft()
             if max_new_tokens == 1 or (eos is not None and first == eos):
                 results[idx] = [first]
                 pool.release(slot)
@@ -776,14 +805,36 @@ def generate(params, prompts, max_new_tokens: int,
                 live[slot] = [idx, [first]]
         if not live:
             continue
-        for slot, tok in pool.step().items():
-            idx, toks = live[slot]
-            toks.append(tok)
-            if len(toks) >= max_new_tokens or (eos is not None and tok == eos):
-                results[idx] = toks
-                pool.release(slot)
-                del live[slot]
+        for slot, step_toks in pool.step().items():
+            if not isinstance(step_toks, (list, tuple)):
+                step_toks = (step_toks,)
+            idx, toks = live.get(slot, (None, None))
+            if idx is None:
+                continue
+            for tok in step_toks:
+                toks.append(tok)
+                if len(toks) >= max_new_tokens or (eos is not None and tok == eos):
+                    results[idx] = toks
+                    pool.release(slot)
+                    del live[slot]
+                    break
     return [results[i] for i in range(len(prompts))]
+
+
+_PAGED_EXPORTS = ("BlockAllocator", "NoFreeBlocksError", "PagedDecodeSlotPool")
+
+
+def __getattr__(name):
+    # Lazy re-export of the paged pool (PEP 562): paged_decode imports THIS
+    # module's building blocks, so an eager import here would be cyclic
+    # whenever paged_decode lands in sys.modules first.  generate() looks
+    # the class up through module globals before falling back here, which
+    # keeps `transformer.PagedDecodeSlotPool = Fake` patching working.
+    if name in _PAGED_EXPORTS:
+        from . import paged_decode
+
+        return getattr(paged_decode, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def make_qa_train_step(cfg: TransformerConfig, updater):

@@ -34,6 +34,20 @@ per-step truth)::
     tdl_decode_evicted_total{reason}        sequences evicted mid-decode
                                             (deadline, shutdown)
 
+Paged-decode families (ISSUE 17 — block-paged KV arena, CoW prefix sharing
+and speculative decoding; all zero/absent when a dense slot pool serves)::
+
+    tdl_decode_blocks_total                 usable KV arena blocks (gauge;
+                                            trash block excluded)
+    tdl_decode_blocks_free                  blocks free for admission (gauge;
+                                            CoW reserves held back)
+    tdl_decode_cow_shared_blocks            blocks referenced by >1 sequence
+                                            via prefix sharing (gauge)
+    tdl_decode_spec_proposed_total          draft-model tokens proposed
+    tdl_decode_spec_accepted_total          proposed tokens accepted by the
+                                            target verify forward (the ratio
+                                            is the acceptance rate)
+
 Replica-pool families (ISSUE 13 — the ServingPool supervisor's view; the
 per-replica serving families above arrive with ``proc=replica{N}`` labels
 through the PR 7 spool merge)::
@@ -115,6 +129,23 @@ def decode_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespac
             "tdl_decode_evicted_total",
             "sequences evicted mid-decode before finishing",
             labels=("reason",)),
+        blocks_total=r.gauge(
+            "tdl_decode_blocks_total",
+            "usable KV blocks in the paged decode arena (trash excluded)"),
+        blocks_free=r.gauge(
+            "tdl_decode_blocks_free",
+            "paged KV blocks free for new admissions (CoW reserves held "
+            "back)"),
+        cow_shared=r.gauge(
+            "tdl_decode_cow_shared_blocks",
+            "paged KV blocks shared by more than one sequence via "
+            "copy-on-write prefix sharing"),
+        spec_proposed=r.counter(
+            "tdl_decode_spec_proposed_total",
+            "draft-model tokens proposed for speculative verification"),
+        spec_accepted=r.counter(
+            "tdl_decode_spec_accepted_total",
+            "speculatively proposed tokens accepted by the target model"),
     )
 
 

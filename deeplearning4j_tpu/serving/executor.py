@@ -55,7 +55,7 @@ log = logging.getLogger(__name__)
 #: recorder (the executor's abandoned paths, the HTTP layer's
 #: ``_record_span``) must split on this ONE set — a new extra added to
 #: only one site would land in ``phases={}`` as fake per-phase seconds.
-SPAN_EXTRA_KEYS = ("batch_rows", "steps", "step_ms")
+SPAN_EXTRA_KEYS = ("batch_rows", "steps", "step_ms", "step_tokens")
 
 
 def span_sampled(request_id: Optional[str], sample_n: int) -> bool:
@@ -533,10 +533,17 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
     traffic. Deadlines shed mid-decode through the existing 504 path
     (the sequence is EVICTED, its slot freed the same step).
 
-    ``session`` is duck-typed (``models.transformer.DecodeSlotPool`` is the
-    real one): ``slots``, ``free_slots``, ``admit(prompt, max_new_tokens)
-    -> (slot, first_token)``, ``step() -> {slot: token}``, ``release(slot)``,
-    plus optional ``eos_id`` / ``max_len`` attributes.
+    ``session`` is duck-typed (``models.transformer.DecodeSlotPool`` and the
+    block-paged ``models.paged_decode.PagedDecodeSlotPool`` are the real
+    ones): ``slots``, ``free_slots``, ``admit(prompt, max_new_tokens) ->
+    (slot, first_token)``, ``step() -> {slot: token | [tokens...]}``,
+    ``release(slot)``, plus optional ``eos_id`` / ``max_len`` attributes.
+    Paged sessions additionally expose ``can_admit``/``request_blocks``/
+    ``total_blocks`` (block-priced admission control), ``block_stats()``
+    (occupancy/CoW/speculation telemetry), ``admit_overhead_tokens``
+    (speculative lookahead slack priced at the door), and an admission
+    error with ``retry_admission = True`` meaning "no blocks RIGHT NOW" —
+    the executor re-queues such a request at the head of the line.
 
     ``continuous=False`` is the measured strawman: admission only into an
     EMPTY pool, so a batch pads to its slowest member exactly like a
@@ -571,6 +578,31 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
         self._tokens_out = 0
         self._admitted = 0
         self._evicted = 0
+        # last (proposed, accepted) seen from the session's speculative
+        # counters — registry counters get the DELTA so restarts of the
+        # session (KvCacheLost reset keeps cumulative counters) stay right
+        self._spec_seen = (0, 0)
+
+    def _sync_session_metrics(self) -> None:
+        """Mirror the paged pool's block/speculation counters into the
+        ``tdl_decode_blocks_*`` / ``tdl_decode_cow_*`` / ``tdl_decode_spec_*``
+        families (no-op for dense slot-pool sessions)."""
+        block_stats = getattr(self.session, "block_stats", None)
+        if block_stats is None:
+            return
+        b = block_stats()
+        self._md.blocks_total.set(b.get("blocks_total", 0))
+        self._md.blocks_free.set(b.get("blocks_free", 0))
+        self._md.cow_shared.set(b.get("cow_shared_blocks", 0))
+        proposed = int(b.get("spec_proposed", 0))
+        accepted = int(b.get("spec_accepted", 0))
+        d_p = proposed - self._spec_seen[0]
+        d_a = accepted - self._spec_seen[1]
+        if d_p > 0:
+            self._md.spec_proposed.inc(d_p)
+        if d_a > 0:
+            self._md.spec_accepted.inc(d_a)
+        self._spec_seen = (proposed, accepted)
 
     # -- admission ---------------------------------------------------------
 
@@ -612,10 +644,26 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
         if mnt < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
         max_len = getattr(self.session, "max_len", None)
-        if max_len is not None and arr.shape[0] + mnt > max_len:
+        # paged pools reserve extra lookahead positions per admission
+        # (speculative drafting scratch) — price it at the door too
+        overhead = int(getattr(self.session, "admit_overhead_tokens", 0) or 0)
+        if max_len is not None and arr.shape[0] + mnt + overhead > max_len:
             raise ValueError(
                 f"prompt of {arr.shape[0]} tokens + max_new_tokens={mnt} "
+                f"{f'+ {overhead} speculative slack ' if overhead else ''}"
                 f"exceeds the {max_len}-position KV cache")
+        # block-priced admission (paged pools): a request whose WORST-CASE
+        # block footprint exceeds the whole arena can never be satisfied —
+        # 400 now, not a guaranteed mid-decode eviction later
+        req_blocks = getattr(self.session, "request_blocks", None)
+        total_blocks = getattr(self.session, "total_blocks", None)
+        if req_blocks is not None and total_blocks is not None:
+            need = int(req_blocks(int(arr.shape[0]), mnt))
+            if need > int(total_blocks):
+                raise ValueError(
+                    f"prompt of {arr.shape[0]} tokens + max_new_tokens={mnt} "
+                    f"needs {need} KV blocks but the paged arena only has "
+                    f"{int(total_blocks)} — unsatisfiable at any load")
         ms = (deadline_ms if deadline_ms is not None
               else self.default_deadline_ms)
         deadline = time.monotonic() + ms / 1000.0 if ms is not None else None
@@ -674,11 +722,32 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
                 if stopping and not self._q and not active:
                     return
                 candidates: List[GenerationFuture] = []
+                blocked_head = False
                 if self.continuous or not active:
                     free = self.session.free_slots
+                    can_admit = getattr(self.session, "can_admit", None)
                     while self._q and len(candidates) < free:
+                        if can_admit is not None:
+                            # block-priced head-of-line gate (paged pools):
+                            # leave a request that cannot be admitted NOW at
+                            # the queue head instead of bouncing it through
+                            # an admit/requeue cycle every iteration
+                            try:
+                                fits = can_admit(self._q[0].x,
+                                                 self._q[0].max_new_tokens)
+                            except Exception:
+                                fits = True  # let admit() produce the error
+                            if not fits:
+                                blocked_head = True
+                                break
                         candidates.append(self._q.popleft())
                     self._m.queue_depth.set(len(self._q))
+                if blocked_head and not active and not candidates:
+                    # nothing live to retire and the head cannot fit: wait a
+                    # beat instead of spinning hot (unreachable for valid
+                    # requests — submit() 400s anything an EMPTY arena
+                    # cannot hold — but a duck-typed session could get here)
+                    self._cv.wait(0.01)
             for fut in candidates:
                 self._admit_into_slot(fut, active)
             if not active:
@@ -710,6 +779,16 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
             fault_point("infer")
             slot, first = self.session.admit(fut.x, fut.max_new_tokens)
         except Exception as e:
+            if getattr(e, "retry_admission", False):
+                # the paged arena is out of blocks RIGHT NOW (another
+                # candidate admitted this very iteration took them): put
+                # the request back at the head of the line — live
+                # sequences retiring will free its blocks; its deadline
+                # still shields the queue wait
+                with self._cv:
+                    self._q.appendleft(fut)
+                    self._m.queue_depth.set(len(self._q))
+                return
             log.warning("prefill failed for request %s: %s: %s",
                         fut.request_id, type(e).__name__, e)
             fut._resolve(error=e)
@@ -737,13 +816,14 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
         if fut.sampled:
             fut.span = {"queue": now - fut.enqueued_at,
                         "prefill": prefill_s, "decode": 0.0,
-                        "steps": 0, "step_ms": []}
+                        "steps": 0, "step_ms": [], "step_tokens": []}
         if (fut.max_new_tokens == 1
                 or (self.eos_id is not None and first == self.eos_id)):
             self.session.release(slot)  # done at prefill: slot never held
             self._finish(fut)
         else:
             active[slot] = fut
+        self._sync_session_metrics()
 
     def _decode_step(self, active: Dict[int, GenerationFuture]) -> None:
         t0 = time.monotonic()
@@ -770,24 +850,39 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
             return
         dt = time.monotonic() - t0
         self._md.steps.inc()
-        self._md.tokens.inc(len(out))
         self._md.slot_occupancy.set(len(active))
         self._steps += 1
         self._occupancy_sum += len(active)
-        self._tokens_out += len(out)
         now = time.monotonic()
+        emitted_total = 0
         for slot in list(active):
             fut = active[slot]
-            tok = out[slot]
-            fut.tokens.append(tok)
+            # dense sessions emit one int per slot; paged sessions a list
+            # (1 token plain, up to spec_tokens+1 speculative) — accept
+            # both, clamped to the request's budget and truncated at EOS
+            step_out = out[slot]
+            if not isinstance(step_out, (list, tuple)):
+                step_out = (step_out,)
             fut.steps += 1
+            chunk = 0
+            hit_eos = False
+            for tok in step_out:
+                if len(fut.tokens) >= fut.max_new_tokens:
+                    break
+                fut.tokens.append(int(tok))
+                chunk += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    hit_eos = True
+                    break
+            emitted_total += chunk
             if fut.sampled and fut.span is not None:
                 fut.span["decode"] += dt
                 fut.span["steps"] = fut.steps
                 if len(fut.span["step_ms"]) < _SPAN_STEP_CAP:
                     fut.span["step_ms"].append(round(dt * 1e3, 3))
-            done = (len(fut.tokens) >= fut.max_new_tokens
-                    or (self.eos_id is not None and tok == self.eos_id))
+                if len(fut.span["step_tokens"]) < _SPAN_STEP_CAP:
+                    fut.span["step_tokens"].append(chunk)
+            done = (hit_eos or len(fut.tokens) >= fut.max_new_tokens)
             if done:
                 self.session.release(slot)
                 del active[slot]
@@ -813,7 +908,10 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
                                   outcome="shed_deadline", code=504,
                                   abandoned=not owns, **phases,
                                   **_trace_kw(fut))
+        self._md.tokens.inc(emitted_total)
+        self._tokens_out += emitted_total
         self._md.slot_occupancy.set(len(active))
+        self._sync_session_metrics()
 
     def _finish(self, fut: GenerationFuture) -> None:
         fut._resolve(result=np.asarray(fut.tokens, np.int32))
@@ -843,8 +941,9 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
         """This executor's continuous-batching aggregates (bench evidence):
         decode steps, emitted tokens, admissions/evictions, and MEAN slot
         occupancy per step — the measured batching-efficiency number the
-        continuous-vs-static comparison reports."""
-        return {
+        continuous-vs-static comparison reports. Paged sessions add block
+        occupancy, CoW savings and the speculative acceptance rate."""
+        s = {
             "steps": self._steps,
             "tokens": self._tokens_out,
             "admitted": self._admitted,
@@ -852,3 +951,15 @@ class GenerativeInferenceExecutor(BatchingInferenceExecutor):
             "mean_slot_occupancy": (round(self._occupancy_sum / self._steps, 3)
                                     if self._steps else 0.0),
         }
+        block_stats = getattr(self.session, "block_stats", None)
+        if block_stats is not None:
+            b = block_stats()
+            s["blocks"] = b
+            total = int(b.get("blocks_total", 0))
+            s["block_occupancy"] = (
+                round(1.0 - b.get("blocks_free", 0) / total, 3) if total else 0.0)
+            proposed = int(b.get("spec_proposed", 0))
+            s["spec_acceptance"] = (
+                round(b.get("spec_accepted", 0) / proposed, 3) if proposed
+                else None)
+        return s

@@ -192,11 +192,21 @@ class JsonModelServer:
 
         def generative(self, session):
             """Serve autoregressive GENERATION (ISSUE 13): ``session`` is a
-            decode slot pool (``models.transformer.DecodeSlotPool`` or
+            decode slot pool (``models.transformer.DecodeSlotPool``, the
+            block-paged ``models.paged_decode.PagedDecodeSlotPool``, or
             duck-equivalent) and the executor underneath becomes the
             continuous-batching decode loop. Payloads are 1-D token
             sequences; responses carry the generated token ids; the
-            ``X-Max-New-Tokens`` header bounds one request's budget."""
+            ``X-Max-New-Tokens`` header bounds one request's budget.
+
+            With a PAGED session (ISSUE 17) admission is priced in KV
+            blocks: a prompt+budget that could never fit the arena is a 400
+            at the door (prompt length and ``X-Max-New-Tokens`` are both
+            checked against the block budget, speculative slack included),
+            a momentary block shortage re-queues behind live sequences
+            (bounded by the same 429/504 shed paths), and ``GET /stats``
+            exposes block occupancy, CoW savings and the speculative
+            acceptance rate."""
             self._kw["generative_session"] = session
             return self
 
@@ -495,6 +505,14 @@ class JsonModelServer:
                     else:
                         self._json({"ready": False, "reason": reason}, 503,
                                    retry_after=RETRY_AFTER_S)
+                elif self.path == "/stats":
+                    # executor aggregates (generative mode adds block
+                    # occupancy / CoW savings / speculative acceptance from
+                    # the paged pool) — the ISSUE 17 "stats() reports block
+                    # occupancy" surface, reachable without a debugger
+                    ex = server._executor
+                    stats = ex.stats() if hasattr(ex, "stats") else {}
+                    self._json({"stats": stats})
                 else:
                     self._json({"error": "POST " + server.endpoint}, 404)
 

@@ -71,7 +71,18 @@ class TraceSpec:
     a day's load curve into ``diurnal_period_s`` seconds. ``deadline_mix``
     is ``((weight, deadline_ms | None), ...)``: each arrival draws its
     deadline from the mix (None = server default), so shed behavior under
-    pressure is part of the replay, not a separate test."""
+    pressure is part of the replay, not a separate test.
+
+    **Shared-prefix request mix (ISSUE 17).** Generative serving with a
+    paged KV cache pays for a common system prompt ONCE via copy-on-write
+    prefix sharing — so the trace must be able to offer that shape of
+    traffic. With ``prefix_tenants > 0``, :meth:`prompt_fn` deterministically
+    assigns arrival ``i`` to tenant ``i % prefix_tenants``; its prompt is
+    the tenant's fixed ``prefix_len``-token system prompt followed by
+    ``suffix_len`` per-request unique tokens (ids in ``[1, prompt_vocab)``
+    — 0 is avoided so a server-side EOS/pad convention cannot truncate the
+    replay). Same seed → byte-identical prompts, so CoW savings measured
+    under replay are reproducible."""
 
     duration_s: float = 10.0
     base_rate: float = 50.0
@@ -81,6 +92,10 @@ class TraceSpec:
     diurnal_phase: float = -math.pi / 2  # start at the trough: ramp up first
     bursts: Tuple[Burst, ...] = ()
     deadline_mix: Tuple[Tuple[float, Optional[float]], ...] = ((1.0, None),)
+    prefix_tenants: int = 0  # 0 = no shared-prefix mix (feature off)
+    prefix_len: int = 32
+    suffix_len: int = 8
+    prompt_vocab: int = 256
 
     def __post_init__(self):
         if self.duration_s <= 0 or self.base_rate <= 0:
@@ -95,6 +110,40 @@ class TraceSpec:
         if not mix or any(w <= 0 for w, _ in mix):
             raise ValueError("deadline_mix needs positive weights")
         object.__setattr__(self, "deadline_mix", mix)
+        if self.prefix_tenants < 0:
+            raise ValueError("prefix_tenants must be >= 0")
+        if self.prefix_tenants:
+            if self.prefix_len < 1 or self.suffix_len < 1:
+                raise ValueError("prefix_len and suffix_len must be >= 1 "
+                                 "when prefix_tenants > 0")
+            if self.prompt_vocab < 2:
+                raise ValueError("prompt_vocab must be >= 2 (ids are drawn "
+                                 "from [1, prompt_vocab))")
+
+    # -- shared-prefix prompts ---------------------------------------------
+
+    def prompt_fn(self) -> Callable[[int], List[int]]:
+        """Deterministic ``index -> token list`` for the shared-prefix mix
+        (``prefix_tenants`` must be > 0) — pass it as a ``LoadGenerator``
+        ``payload_fn`` or feed it to the bench's executor replay. The
+        per-tenant system prompts are fixed for the whole trace; suffixes
+        are unique per request index. Pure function of the spec: same
+        seed, same prompts, any machine."""
+        if not self.prefix_tenants:
+            raise ValueError("prompt_fn needs prefix_tenants > 0 — this "
+                             "spec has no shared-prefix mix")
+        prefix_rng = np.random.default_rng([int(self.seed), 0x5e9])
+        prefixes = [prefix_rng.integers(
+            1, self.prompt_vocab, size=self.prefix_len).tolist()
+            for _ in range(self.prefix_tenants)]
+
+        def fn(i: int) -> List[int]:
+            suffix_rng = np.random.default_rng([int(self.seed), 0xd1f, int(i)])
+            suffix = suffix_rng.integers(
+                1, self.prompt_vocab, size=self.suffix_len).tolist()
+            return prefixes[i % self.prefix_tenants] + suffix
+
+        return fn
 
     # -- rate curve --------------------------------------------------------
 
@@ -152,6 +201,10 @@ class TraceSpec:
             "bursts": [[b.start_s, b.duration_s, b.multiplier]
                        for b in self.bursts],
             "deadline_mix": [list(p) for p in self.deadline_mix],
+            "prefix_tenants": self.prefix_tenants,
+            "prefix_len": self.prefix_len,
+            "suffix_len": self.suffix_len,
+            "prompt_vocab": self.prompt_vocab,
         }
 
     @classmethod

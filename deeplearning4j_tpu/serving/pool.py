@@ -576,6 +576,27 @@ class ServingPool:
         with self._lock:
             return {h.id: h.state for h in self._replicas.values()}
 
+    def replica_stats(self) -> Dict[int, dict]:
+        """Best-effort ``GET /stats`` from every READY replica (ISSUE 17
+        plumbing): for generative replicas over a paged pool this surfaces
+        block occupancy, CoW savings and speculative acceptance fleet-wide
+        — the numbers the capacity bench and a paging postmortem read.
+        Replicas that fail the fetch are simply absent from the result."""
+        import urllib.request
+
+        with self._lock:
+            targets = [(h.id, h.port) for h in self._replicas.values()
+                       if h.state == "ready" and h.port]
+        out: Dict[int, dict] = {}
+        for rid, port in targets:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/stats", timeout=2.0) as resp:
+                    out[rid] = json.loads(resp.read()).get("stats", {})
+            except Exception:
+                log.debug("replica %d /stats fetch failed", rid)
+        return out
+
     def describe(self) -> dict:
         with self._lock:
             return {
@@ -971,6 +992,10 @@ class ServingPool:
                                    503, retry_after=RETRY_AFTER_S)
                 elif self.path == "/replicas":
                     self._json(pool.describe())
+                elif self.path == "/stats":
+                    # fleet view of the replicas' executor stats (paged
+                    # decode: block occupancy / CoW / acceptance, ISSUE 17)
+                    self._json({"replicas": pool.replica_stats()})
                 else:
                     self._json({"error": "POST " + pool.endpoint}, 404)
 

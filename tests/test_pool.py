@@ -705,12 +705,20 @@ def test_pool_chaos_swap_under_load(tmp_path):
 
 @pytest.mark.slow
 def test_pool_chaos_replica_kill_and_10x_burst(tmp_path):
-    """ISSUE 13 acceptance: 32 clients replaying generative traffic with a
-    10x burst while a replica is SIGKILLed mid-flight — only 200/429/504
-    ever escape (the router's failover + the client's pool_unready retry
-    absorb the restart window), p99 stays bounded, and the pool size
-    FOLLOWS the alert signal: up during the burst, back down after, with
-    the alert interval paired (fired AND cleared) and no flap."""
+    """ISSUE 13 acceptance (ISSUE 17 trace): 32 clients replaying a
+    SHARED-PREFIX generative mix (N tenants x a common system prompt, the
+    TraceSpec prefix mix that exercises CoW sharing on a paged session)
+    with a 10x burst while a replica is SIGKILLed mid-flight — only
+    200/429/504 ever escape (the router's failover + the client's
+    pool_unready retry absorb the restart window), p99 stays bounded, and
+    the pool size FOLLOWS the alert signal: up during the burst, back down
+    after, with the alert interval paired (fired AND cleared) and no
+    flap."""
+    from deeplearning4j_tpu.serving import TraceSpec
+
+    prompt_fn = TraceSpec(duration_s=1.0, base_rate=1.0, seed=7,
+                          prefix_tenants=4, prefix_len=24, suffix_len=4,
+                          prompt_vocab=256).prompt_fn()
     reg = MetricsRegistry()
     pool = _pool(
         tmp_path, target="generative_stub_server",
@@ -742,7 +750,8 @@ def test_pool_chaos_replica_kill_and_10x_burst(tmp_path):
             for r in range(requests):
                 t0 = time.perf_counter()
                 try:
-                    client.predict([3 + idx], deadline_ms=deadline_ms,
+                    client.predict(prompt_fn(idx * 100 + r),
+                                   deadline_ms=deadline_ms,
                                    request_id=f"chaos-{idx}-{r}")
                     out = "200"
                 except RuntimeError as e:
