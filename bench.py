@@ -177,6 +177,8 @@ def _scale(on_tpu):
                             replicas=2),
             "ckpt_lineage": dict(features=256, hidden=2048, classes=32,
                                  steps=3, saves=4),
+            "deploy": dict(features=256, hidden=2048, classes=32, steps=3,
+                           canary_requests=2000),
             "compile_cache": dict(features=64, classes=8, batch_limit=16,
                                   max_rows=128, fit_batch=128, fit_steps=4,
                                   flash=dict(B=1, H=12, T=8192, D=64,
@@ -223,6 +225,8 @@ def _scale(on_tpu):
                         replicas=2),
         "ckpt_lineage": dict(features=32, hidden=256, classes=8, steps=2,
                              saves=3),
+        "deploy": dict(features=32, hidden=256, classes=8, steps=2,
+                       canary_requests=400),
         "compile_cache": dict(features=16, classes=4, batch_limit=8,
                               max_rows=32, fit_batch=32, fit_steps=2,
                               flash=dict(B=1, H=2, T=128, D=16, trials=1)),
@@ -1923,6 +1927,151 @@ def bench_ckpt_lineage(p):
     return out
 
 
+# ------------------------------------------------- deployment controller
+
+
+def bench_deploy(p):
+    """ISSUE 18: the price of an unattended promotion decision.
+
+    Walks a real :class:`FleetController` gate chain (no pool — the canary
+    leg is priced separately below) over a live lineage:
+
+    - ``promote_ms`` (the headline): integrity deep-verify + offline eval +
+      promote bookkeeping for one HEALTHY generation — what the controller
+      adds on top of training before a candidate reaches the fleet;
+    - ``integrity_reject_ms``: a bit-flipped generation caught at the first
+      gate — the cheapest rejection (one verified read, no replica risk);
+    - ``eval_reject_ms``: a loss-spiked generation (structurally perfect,
+      numbers ruined) caught by the eval gate's threshold + regression band;
+    - ``canary_judge_windows_per_s``: throughput of the paired old-vs-
+      candidate SLO judgement (window pairing + AlertRule evaluation per
+      sub-window) over synthetic replay rows — the gate's analysis cost,
+      isolated from the replay's wall time.
+
+    Runs every ``tdl_deploy_*`` and ``tdl_eval_*`` family hot for
+    ``--check-telemetry``."""
+    import tempfile
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.deploy import FleetController
+    from deeplearning4j_tpu.monitoring import get_registry
+    from deeplearning4j_tpu.monitoring.deploy import (canary_rules,
+                                                      judge_canary_windows,
+                                                      paired_canary_windows)
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serde.checkpoint import TrainingCheckpointer
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_in=p["features"], n_out=p["hidden"],
+                              activation="relu"))
+            .layer(OutputLayer(n_out=p["classes"], activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, p["features"]).astype(np.float32)
+    Y = np.eye(p["classes"], dtype=np.float32)[
+        rs.randint(0, p["classes"], 32)]
+
+    def weight_eval(gendir):
+        # spiked generations carry blown-up parameters: a cheap stand-in
+        # for a held-out eval with the same verdict structure
+        shard = sorted(f for f in os.listdir(gendir)
+                       if f.startswith("shard_"))[0]
+        with np.load(os.path.join(gendir, shard)) as z:
+            mags = [float(np.abs(z[k]).mean()) for k in z.files
+                    if k.startswith("params/")and not k.endswith(
+                        ("|idx", "|shape"))]
+        return {"accuracy": 0.9 if max(mags) < 0.5 else 0.1}
+
+    out = {"metric": "deploy_promote_ms", "unit": "ms"}
+    with tempfile.TemporaryDirectory() as d:
+        ck = TrainingCheckpointer(os.path.join(d, "ck"), async_write=False,
+                                  keep_last=8)
+        import jax as _jax
+
+        for _ in range(p["steps"]):
+            net._fit_batch(DataSet(X, Y))
+        ck.save(net)  # healthy candidate
+        ctl = FleetController(os.path.join(d, "ck"),
+                              workdir=os.path.join(d, "deploy"),
+                              eval_fn=weight_eval,
+                              eval_thresholds={"accuracy": 0.8},
+                              regression_band=0.1, retries=0,
+                              registry=get_registry())
+        try:
+            t0 = time.perf_counter()
+            ctl.run_once()
+            out["promote_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+            out["value"] = out["promote_ms"]
+            assert ctl.state["promoted"] is not None
+
+            # loss-spiked candidate → eval-gate rejection
+            net.params_ = _jax.tree.map(lambda a: a * 40.0, net.params_)
+            net._fit_batch(DataSet(X, Y))
+            ck.save(net)
+            t0 = time.perf_counter()
+            rows = ctl.run_once()
+            out["eval_reject_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            assert rows[-1]["rejected_by"]["gate"] == "eval"
+
+            # bit-flipped candidate → integrity-gate rejection
+            net._fit_batch(DataSet(X, Y))
+            ck.save(net)
+            from deeplearning4j_tpu.common.faults import _flip_bit_in_shard
+
+            assert _flip_bit_in_shard(ck.committed_generation()) is not None
+            t0 = time.perf_counter()
+            rows = ctl.run_once()
+            out["integrity_reject_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            assert rows[-1]["rejected_by"]["gate"] == "integrity"
+        finally:
+            ctl.close()
+
+    # the to_metrics hook (classification + regression): eval verdicts land
+    # on /metrics under the model label
+    from deeplearning4j_tpu.eval import Evaluation, RegressionEvaluation
+
+    ev = Evaluation()
+    y = np.eye(p["classes"], dtype=np.float32)[
+        rs.randint(0, p["classes"], 64)]
+    ev.eval(y, y)
+    ev.to_metrics(get_registry(), model="bench-clf")
+    rev = RegressionEvaluation()
+    t = rs.randn(64, 1).astype(np.float32)
+    rev.eval(t, t + 0.1 * rs.randn(64, 1).astype(np.float32))
+    rev.to_metrics(get_registry(), model="bench-reg")
+
+    # paired canary judgement throughput over synthetic replay rows
+    rs = np.random.RandomState(1)
+    n = p["canary_requests"]
+    dur = 4.0
+
+    def arm_rows(lat_ms):
+        return [{"t": float(t), "outcome": "200",
+                 "latency_ms": float(max(0.1, rs.normal(lat_ms, 2.0)))}
+                for t in np.linspace(0, dur, n, endpoint=False)]
+
+    base, cand = arm_rows(5.0), arm_rows(30.0)
+    t0 = time.perf_counter()
+    windows = paired_canary_windows(base, cand, duration_s=dur,
+                                    window_s=0.25, threshold_ms=10.0,
+                                    target=0.99)
+    verdict = judge_canary_windows(windows, canary_rules(),
+                                   registry=get_registry())
+    judge_s = time.perf_counter() - t0
+    assert not verdict["ok"]  # the slow arm must trip the paired rules
+    out["canary_judge_windows_per_s"] = round(
+        verdict["judged"] / max(judge_s, 1e-9), 1)
+    out["canary_requests"] = 2 * n
+    return out
+
+
 # ------------------------------------------------------- compile cache
 
 
@@ -2232,6 +2381,7 @@ BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "serving_pool": bench_serving_pool,
            "reshard": bench_reshard,
            "ckpt_lineage": bench_ckpt_lineage,
+           "deploy": bench_deploy,
            "compile_cache": bench_compile_cache,
            "trace_overhead": bench_trace_overhead,
            "paged_decode": bench_paged_decode}

@@ -29,6 +29,21 @@ production preemption would.
                                  restore-side CRC verify must catch
     enospc@iter=4,rank=0         raise OSError(ENOSPC) at the checkpoint
                                  write site — the disk-full save failure
+    loss_spike@iter=4,scale=40   multiply the model parameters by ``scale``
+                                 (default 40) at the named train iteration:
+                                 the run keeps training and keeps COMMITTING
+                                 perfectly valid checkpoints whose weights
+                                 are ruined — the poisoned candidate only an
+                                 OFFLINE EVAL gate can reject (ISSUE 18)
+    latency_inject@value=0.4,model=gen-00000008
+                                 sleep ``value`` seconds inside every
+                                 inference batch, but ONLY in processes
+                                 whose ``TDL_MODEL_CKPT`` contains the
+                                 ``model`` substring — a regression that
+                                 ships with one model version and therefore
+                                 surfaces only on the CANARY replica serving
+                                 it (ISSUE 18); no ``model=`` param degrades
+                                 every replica (then prefer ``slow_infer``)
 
 The serving faults (ISSUE 5) fire at the ``infer`` site inside
 ``serving.executor.BatchingInferenceExecutor`` — the same machinery a wedged
@@ -81,6 +96,7 @@ CKPT_STAGES = ("shard", "manifest", "commit", "pointer")
 class Fault:
     kind: str   # "crash" | "hang" | "slow_ckpt_io" | "slow_infer"
     #             | "fail_infer" | "torn_ckpt" | "corrupt_ckpt" | "enospc"
+    #             | "loss_spike" | "latency_inject"
     params: Dict[str, str] = field(default_factory=dict)
 
     @property
@@ -125,7 +141,8 @@ def parse_fault_spec(spec: str) -> List[Fault]:
             kind, params = clause, {}
         kind = kind.strip()
         if kind not in ("crash", "hang", "slow_ckpt_io", "slow_infer",
-                        "fail_infer", "torn_ckpt", "corrupt_ckpt", "enospc"):
+                        "fail_infer", "torn_ckpt", "corrupt_ckpt", "enospc",
+                        "loss_spike", "latency_inject"):
             raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
         if kind == "torn_ckpt" and \
                 params.get("stage", "commit") not in CKPT_STAGES:
@@ -245,6 +262,20 @@ class FaultInjector:
                 if ("restart" not in f.params
                         or f.fires_in_incarnation(self.incarnation)):
                     time.sleep(f.value)
+            elif site == "infer" and f.kind == "latency_inject":
+                # model-targeted serving latency (ISSUE 18): fires only in
+                # processes whose TDL_MODEL_CKPT carries the `model`
+                # substring — the regression that ships WITH a candidate
+                # version, visible only on the canary replica serving it
+                want = f.params.get("model")
+                if want and want not in os.environ.get("TDL_MODEL_CKPT", ""):
+                    continue
+                if f.rank is not None and f.rank != self.rank:
+                    continue
+                if ("restart" in f.params
+                        and not f.fires_in_incarnation(self.incarnation)):
+                    continue
+                time.sleep(f.value)
             elif site == "infer" and f.kind in ("slow_infer", "fail_infer"):
                 if f.rank is not None and f.rank != self.rank:
                     continue
@@ -261,6 +292,31 @@ class FaultInjector:
                         raise InjectedFault(
                             f"fault injection: fail_infer "
                             f"(inference call {self._infer_calls})")
+
+
+    def poison(self, site: str, iteration: Optional[int] = None
+               ) -> Optional[float]:
+        """``loss_spike`` clauses: the multiplicative parameter perturbation
+        to apply at this train step, or None. Unlike :meth:`fire` this
+        cannot raise/exit — the poisoned run must keep training and keep
+        committing VALID checkpoints whose weights are ruined, because the
+        whole point (ISSUE 18) is an artifact only an offline eval gate can
+        reject. One-shot by default (first incarnation), like ``crash``."""
+        if site != "train_step":
+            return None
+        for f in self.faults:
+            if f.kind != "loss_spike":
+                continue
+            if not self._matches(f, iteration):
+                continue
+            self._flight_note(f, iteration)
+            scale = float(f.params.get("scale", "40"))
+            log.warning(
+                "fault injection: loss_spike x%g at iteration %s rank %s "
+                "(incarnation %s)", scale, iteration, self.rank,
+                self.incarnation)
+            return scale
+        return None
 
 
 def _flip_bit_in_shard(gendir: str) -> Optional[str]:
@@ -306,3 +362,21 @@ def fault_point(site: str, iteration: Optional[int] = None,
         _cached = FaultInjector.from_env()
         _cached_key = key
     _cached.fire(site, iteration, path=path)
+
+
+def poison_scale(site: str = "train_step",
+                 iteration: Optional[int] = None) -> Optional[float]:
+    """Library hook for ``loss_spike`` (same env contract and caching as
+    :func:`fault_point`): the parameter-scale perturbation to apply at this
+    step, or None. The trainer multiplies its parameter tree by the returned
+    factor — training continues and commits valid-but-ruined checkpoints."""
+    global _cached, _cached_key
+    spec = os.environ.get(ENV_SPEC)
+    if not spec:
+        return None
+    key = (spec, os.environ.get(ENV_RANK, "0"),
+           os.environ.get(ENV_INCARNATION, "0"))
+    if _cached is None or key != _cached_key:
+        _cached = FaultInjector.from_env()
+        _cached_key = key
+    return _cached.poison(site, iteration)

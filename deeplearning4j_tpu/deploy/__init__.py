@@ -1,0 +1,6 @@
+"""Self-driving model lifecycle (ISSUE 18): the unattended
+train → validate → canary → promote controller."""
+
+from .controller import FleetController, GATE_CHAIN
+
+__all__ = ["FleetController", "GATE_CHAIN"]

@@ -5,7 +5,8 @@ from .evaluation import (
     RegressionEvaluation,
     ROC,
     ROCMultiClass,
+    eval_metrics,
 )
 
 __all__ = ["Evaluation", "RegressionEvaluation", "ROC", "EvaluationBinary",
-           "ROCMultiClass", "EvaluationCalibration"]
+           "ROCMultiClass", "EvaluationCalibration", "eval_metrics"]

@@ -78,6 +78,8 @@ EVENT_KINDS = frozenset({
     "pool_scale", "pool_swap_rejected", "pool_swap_begin", "pool_swap",
     "pool_swap_rollback", "replica_spawn", "replica_retire",
     "replica_drain_complete", "replica_death", "replica_breaker_open",
+    # deployment controller (ISSUE 18)
+    "deploy_candidate", "deploy_gate", "deploy_promote", "deploy_rollback",
 })
 
 

@@ -334,6 +334,14 @@ class ParallelTrainer:
         if flight_on:
             flight.record("step_begin", iteration=it)
         faults.fault_point("train_step", iteration=it)
+        spike = faults.poison_scale("train_step", iteration=it)
+        if spike is not None:
+            # loss_spike poisoning (ISSUE 18): scale the whole parameter
+            # tree — training proceeds and the checkpointer keeps committing
+            # structurally PERFECT generations whose weights are ruined,
+            # the candidate only an offline eval gate can reject
+            self.net.params_ = jax.tree.map(
+                lambda a: a * spike, self.net.params_)
         now = time.perf_counter()
         if self._last_step_entry is not None:
             # iteration-to-iteration wall: includes checkpoint IO / barriers

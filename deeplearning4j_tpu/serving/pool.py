@@ -159,6 +159,7 @@ class ReplicaHandle:
     restarts: int = 0
     retiring: bool = False
     surge: bool = False              # swap-roll extra: not a desired seat
+    canary: bool = False             # ISSUE 18: never routed live traffic
     signaled: bool = False           # SIGTERM sent (drain complete/forced)
     drain_deadline: float = 0.0      # forced-signal time for a drain
     inflight: int = 0                # router's in-flight count (least-loaded)
@@ -414,13 +415,21 @@ class ServingPool:
             # which remains the universal gate
             if not report["ok"] and report["reason"] != "no_checkpoint":
                 self._m.swap_rejected.inc()
+                # the FULL verify verdict rides the event and the error
+                # (ISSUE 18 satellite): an audit trail must name why the
+                # candidate was refused, not just that it was
                 flight.record("pool_swap_rejected", model=str(ckpt),
                               reason=report["reason"],
-                              generation=report.get("generation"))
+                              generation=report.get("generation"),
+                              iteration=report.get("iteration"),
+                              format=report.get("format"),
+                              verify_seconds=report.get("seconds"))
                 raise ValueError(
                     f"swap_model rejected checkpoint {ckpt}: verification "
-                    f"failed ({report['reason']}, generation "
-                    f"{report.get('generation')}) — no surge replica was "
+                    f"failed (reason={report['reason']}, generation="
+                    f"{report.get('generation')}, iteration="
+                    f"{report.get('iteration')}, format="
+                    f"{report.get('format')}) — no surge replica was "
                     "spawned, the serving fleet is untouched")
         if not self._swap_lock.acquire(blocking=False):
             raise RuntimeError("a model swap is already in progress")
@@ -510,6 +519,44 @@ class ServingPool:
             "model swap rolled back: new-version replica %d never became "
             "ready (%d replicas already rolled keep the new version; the "
             "rest keep serving the old one)", surge.id, swapped)
+
+    # -- canary surge (ISSUE 18) -------------------------------------------
+
+    def start_canary(self, ckpt: Optional[str] = None, *,
+                     env: Optional[Dict[str, str]] = None,
+                     ready_timeout: Optional[float] = None) -> ReplicaHandle:
+        """Surge ONE extra replica pinned to a candidate model version and
+        wait (bounded) until it probes ready — the deployment controller's
+        canary arm. The replica is marked ``canary``: the router NEVER
+        dispatches live traffic to it (mirrored replay hits its ``.port``
+        directly), the reconciler neither counts nor retires it, and the old
+        fleet keeps serving untouched. A canary that dies or never becomes
+        ready within ``ready_timeout`` (default ``swap_ready_timeout``) is
+        killed and ``TimeoutError`` raised — the wedged-canary bound the
+        gate chain relies on. Callers own the handle: pass it to
+        :meth:`stop_canary` when the verdict is in."""
+        overrides = dict(self._default_overrides)
+        overrides.update(env or {})
+        if ckpt is not None:
+            overrides[ENV_MODEL_CKPT] = str(ckpt)
+        with self._lock:
+            h = self._spawn_replica(env_overrides=overrides, surge=True)
+            h.canary = True
+        timeout = (ready_timeout if ready_timeout is not None
+                   else self.swap_ready_timeout)
+        if not self._await_replica_ready(h, timeout):
+            self._retire_now(h)
+            raise TimeoutError(
+                f"canary replica {h.id} never became ready within "
+                f"{timeout:.1f}s (model "
+                f"{overrides.get(ENV_MODEL_CKPT)!r}) — killed; the serving "
+                "fleet is untouched")
+        return h
+
+    def stop_canary(self, h: ReplicaHandle) -> None:
+        """Kill + reap a canary surge replica (no drain: the router never
+        dispatched to it, only the mirrored replay did)."""
+        self._retire_now(h)
 
     def _await_replica_ready(self, h: ReplicaHandle, timeout: float) -> bool:
         """Wait for ONE replica to probe ready; fail fast when its process
@@ -607,6 +654,7 @@ class ServingPool:
                     "id": h.id, "state": h.state, "port": h.port,
                     "inflight": h.inflight, "restarts": h.restarts,
                     "retiring": h.retiring, "surge": h.surge,
+                    "canary": h.canary,
                     "model": h.env_overrides.get(ENV_MODEL_CKPT),
                     "breaker_open": not h.breaker_closed(time.monotonic()),
                 } for h in self._replicas.values()],
@@ -922,6 +970,7 @@ class ServingPool:
         with self._lock:
             ok = [h for h in self._replicas.values()
                   if h.state == "ready" and not h.retiring and h.alive
+                  and not h.canary  # mirrored replay only, never live load
                   and h.port is not None and h.id not in exclude
                   and h.breaker_closed(now)]
             if not ok:

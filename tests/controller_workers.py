@@ -1,0 +1,137 @@
+"""Worker + eval targets for the deployment-controller tests (ISSUE 18).
+
+Loaded BY PATH in two roles:
+
+- ``lifecycle_train`` is a ``GangSupervisor`` worker target (the e2e chaos
+  training run). Unlike ``mp_workers.supervised_train`` its labels are a
+  DETERMINISTIC function of the inputs, so a healthy checkpoint evaluates to
+  genuinely high held-out accuracy while a ``loss_spike``-poisoned one
+  craters — the separation the controller's offline eval gate judges.
+- ``eval_candidate`` / ``eval_sleepy`` are controller ``eval_target``
+  functions (``gen_dir -> metrics``), importable in-process and loadable by
+  the ``python -m deeplearning4j_tpu.deploy.controller`` subprocess.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+#: fixed 6->3 linear map: labels = argmax(x @ W) — learnable, deterministic
+_TASK_W = np.asarray(
+    [[1.2, -0.7, 0.1], [-0.9, 1.1, 0.3], [0.4, 0.2, -1.3],
+     [0.8, -1.0, 0.6], [-0.5, 0.9, -0.2], [0.3, -0.4, 1.0]], np.float32)
+
+
+def _task_batch(step, n=32):
+    rs = np.random.RandomState(500 + step)
+    x = rs.rand(n, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ _TASK_W, axis=1)]
+    return x, y
+
+
+def _toy_net(seed=7):
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (
+        NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2)).list()
+        .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(6))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def lifecycle_train():
+    """Gang worker: data-parallel training on the learnable task with
+    lineage checkpoints every ``TDL_MP_CKPT_EVERY`` steps and
+    restore-from-latest on start (the supervisor restart contract). Chaos
+    rides ``TDL_FAULT_SPEC`` through the real ``_fit_core`` hooks — a
+    ``crash`` kills a rank mid-run, a ``loss_spike`` ruins the weights while
+    the checkpointer keeps committing structurally perfect generations."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel.launcher import ProcessCollectives
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.trainer import MultiProcessTrainer
+    from deeplearning4j_tpu.serde.checkpoint import TrainingCheckpointer
+
+    col = ProcessCollectives()
+    rank, world = col.rank, col.world
+    total_steps = int(os.environ.get("TDL_MP_STEPS", "12"))
+    every = int(os.environ.get("TDL_MP_CKPT_EVERY", "3"))
+
+    net = _toy_net()
+    ck = TrainingCheckpointer(os.environ["TDL_MP_CKPT"], async_write=False,
+                              keep_last=8)  # the controller wants them all
+    start = 0
+    if ck.restore(net):
+        start = int(net.iteration)
+    trainer = MultiProcessTrainer(net, build_mesh(data=-1))
+    for step in range(start, total_steps):
+        x, y = _task_batch(step)
+        lo = rank * (len(x) // world)
+        hi = lo + len(x) // world
+        trainer.fit([DataSet(x[lo:hi], y[lo:hi])])
+        if (step + 1) % every == 0:
+            col.barrier(f"ck-{step}")
+            ck.save(net)
+            col.barrier(f"ck-done-{step}")
+
+    out = os.environ.get("TDL_MP_OUT")
+    if out:
+        with open(out + f".rank{rank}", "w") as f:
+            json.dump({"start": start, "iteration": int(net.iteration)}, f)
+
+
+def _restore_generation(gendir):
+    """Load ONE specific generation into a fresh net. ``restore()`` loads
+    the newest committed generation of a lineage, so build a throwaway
+    lineage holding just this generation (symlink — zero copy) and restore
+    through the normal verified path."""
+    import tempfile
+
+    from deeplearning4j_tpu.serde.checkpoint import TrainingCheckpointer
+
+    gendir = os.path.normpath(gendir)
+    name = os.path.basename(gendir)
+    root = tempfile.mkdtemp(prefix="tdl-eval-")
+    lineage = os.path.join(root, "latest")
+    os.makedirs(lineage)
+    os.symlink(gendir, os.path.join(lineage, name))
+    with open(os.path.join(lineage, "LATEST"), "w") as f:
+        f.write(name + "\n")
+    net = _toy_net()
+    if not TrainingCheckpointer(root, async_write=False).restore(net):
+        raise RuntimeError(f"no committed checkpoint under {gendir}")
+    return net
+
+
+def eval_candidate(gendir):
+    """Controller eval target: restore the candidate generation and judge it
+    on held-out batches the training run never saw. The headline ``score``
+    is log-loss based (``1/(1+xent)``) — argmax accuracy is nearly invariant
+    to a multiplicative weight spike (saturated tanh keeps its sign
+    pattern), but the spiked net's exploded CONFIDENCE on wrong samples
+    makes its held-out cross-entropy, and therefore this score, crater."""
+    net = _restore_generation(gendir)
+    losses, accs = [], []
+    for step in (901, 902, 903):
+        x, y = _task_batch(step, n=64)
+        p = np.clip(np.asarray(net.output(x).numpy()), 1e-12, 1.0)
+        losses.append(float(-(y * np.log(p)).sum(axis=1).mean()))
+        accs.append(float((p.argmax(1) == y.argmax(1)).mean()))
+    return {"score": 1.0 / (1.0 + float(np.mean(losses))),
+            "accuracy": float(np.mean(accs))}
+
+
+def eval_sleepy(gendir):
+    """Deterministic eval target for the SIGKILL-resume test: sleep
+    ``TDL_EVAL_SLEEP`` seconds (long in the run that gets killed mid-gate,
+    unset in the resumed run), then return a fixed verdict."""
+    time.sleep(float(os.environ.get("TDL_EVAL_SLEEP", "0")))
+    return {"accuracy": float(os.environ.get("TDL_EVAL_ACC", "0.9"))}

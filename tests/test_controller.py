@@ -1,0 +1,750 @@
+"""Self-driving model lifecycle (ISSUE 18 tentpole).
+
+The :class:`FleetController` watches a checkpoint lineage and drives every
+newly committed generation through integrity → eval → canary gates,
+promoting on sustained-clear and rolling back on any failure. Covers: the
+poisoned-candidate matrix (bit-flipped → integrity gate, loss-spiked →
+eval gate, latency-injected → canary SLO gate — each rejected at the
+EARLIEST gate that can catch it, with the old fleet untouched), durable
+SIGKILL/restart resume to the same terminal verdict, bounded gate timeouts,
+transient-error retry, the decision-event AST lint (with a planted-offender
+self-test), the eval ``to_metrics`` hook, and the enriched swap-rejection
+payload.
+"""
+
+import ast
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.deploy import GATE_CHAIN, FleetController
+from deeplearning4j_tpu.monitoring import MetricsRegistry
+from deeplearning4j_tpu.monitoring.flight import (EVENT_KINDS, FlightRecorder,
+                                                  set_flight_recorder)
+from deeplearning4j_tpu.serde.checkpoint import (_array_crc, _gen_name,
+                                                 _self_checksummed)
+from deeplearning4j_tpu.serving import ServingPool
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+_POOL_WORKERS = str(pathlib.Path(__file__).resolve().parent
+                    / "pool_workers.py")
+_CTRL_WORKERS = str(pathlib.Path(__file__).resolve().parent
+                    / "controller_workers.py")
+_GANG_WORKERS = str(pathlib.Path(__file__).resolve().parent
+                    / "mp_workers.py")
+
+
+# ------------------------------------------------------------- helpers
+
+
+def _make_gen(lineage, it, corrupt=False, scale=1.0):
+    """Hand-roll one COMMITTED generation (the test_pool idiom). ``scale``
+    multiplies the weights — a structurally perfect artifact with ruined
+    numbers, the loss-spike poison's signature. ``corrupt`` flips a byte in
+    the shard AFTER the commit — latent bit-rot."""
+    gen = _gen_name(it)
+    gendir = os.path.join(str(lineage), gen)
+    os.makedirs(gendir)
+    w = (np.linspace(-0.5, 0.5, 64).astype(np.float32) * scale)
+    blob = {"__save_id__": np.asarray(it, np.int64),
+            "params/0/W|0": w,
+            "params/0/W|0|idx": np.asarray([[0, 64]], np.int64),
+            "params/0/W|0|shape": np.asarray([64], np.int64)}
+    with open(os.path.join(gendir, "shard_0.npz"), "wb") as f:
+        np.savez(f, **blob)
+    manifest = _self_checksummed({
+        "save_id": it, "proc": 0, "shard": "shard_0.npz",
+        "process_count": 1, "layout": None,
+        "entries": {k: _array_crc(v) for k, v in blob.items()},
+        "nbytes": 0})
+    with open(os.path.join(gendir, "manifest_0.json"), "w") as f:
+        f.write(json.dumps(manifest))
+    with open(os.path.join(gendir, "train_state.json"), "w") as f:
+        f.write(json.dumps(_self_checksummed(
+            {"iteration": it, "epoch": 0, "score": None,
+             "process_count": 1, "generation": gen})))
+    with open(os.path.join(gendir, "COMMIT"), "w") as f:
+        f.write("{}")
+    with open(os.path.join(str(lineage), "LATEST"), "w") as f:
+        f.write(gen + "\n")
+    if corrupt:
+        shard = os.path.join(gendir, "shard_0.npz")
+        raw = open(shard, "rb").read()
+        off = raw.index(w.tobytes()) + 8
+        with open(shard, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+    return gendir
+
+
+def _weight_eval(gendir):
+    """Weight-reading eval stub: a loss-spiked generation's blown-up
+    parameters score near zero, a healthy one near 0.9."""
+    with np.load(os.path.join(gendir, "shard_0.npz")) as z:
+        w = z["params/0/W|0"]
+    return {"accuracy": 0.9 if float(np.abs(w).mean()) < 1.0 else 0.1}
+
+
+def _counter_values(reg, name):
+    m = reg.get(name)
+    if m is None:
+        return {}
+    return {tuple(s["labels"].values()): s["value"]
+            for s in m.snapshot()["series"]}
+
+
+def _controller(tmp_path, **kw):
+    kw.setdefault("workdir", str(tmp_path / "deploy"))
+    kw.setdefault("eval_fn", _weight_eval)
+    kw.setdefault("eval_thresholds", {"accuracy": 0.8})
+    kw.setdefault("retries", 0)
+    kw.setdefault("retry_backoff_s", 0.05)
+    kw.setdefault("registry", MetricsRegistry())
+    return FleetController(str(tmp_path / "ck"), **kw)
+
+
+@pytest.fixture
+def lineage(tmp_path):
+    d = tmp_path / "ck" / "latest"
+    d.mkdir(parents=True)
+    return d
+
+
+# ------------------------------------------- gate chain without a pool
+
+
+def test_healthy_candidate_promotes_and_survives_restart(tmp_path, lineage):
+    """A committed healthy generation walks integrity → eval and promotes
+    (no pool: promotion moves the durable baseline); a second controller on
+    the same workdir re-derives nothing — terminal verdicts are durable."""
+    _make_gen(lineage, 2)
+    c = _controller(tmp_path)
+    try:
+        out = c.run_once()
+        assert [e["status"] for e in out] == ["promoted"]
+        assert c.state["promoted"]["generation"] == _gen_name(2)
+        assert c.state["promoted"]["metrics"]["accuracy"] == 0.9
+        kinds = [e["kind"] for e in c._own_recorder.events()]
+        assert kinds == ["deploy_candidate", "deploy_gate", "deploy_gate",
+                         "deploy_promote"]
+        reg = c.registry
+        assert _counter_values(reg, "tdl_deploy_promotions_total") == {(): 1}
+        assert reg.get("tdl_deploy_promoted_generation").value == 2.0
+        audit = json.load(open(c.audit_path))
+        assert audit["promoted"]["generation"] == _gen_name(2)
+        gates = [v["gate"] for v in audit["candidates"][0]["verdicts"]]
+        assert gates == ["integrity", "eval"]
+    finally:
+        c.close()
+
+    c2 = _controller(tmp_path, registry=MetricsRegistry())
+    try:
+        assert c2.run_once() == []  # nothing new, nothing re-judged
+        assert c2.state["promoted"]["generation"] == _gen_name(2)
+    finally:
+        c2.close()
+
+
+def test_bit_flipped_candidate_rejected_at_integrity_gate(tmp_path, lineage):
+    """Poison matrix 1: a bit-flipped generation dies at the FIRST gate —
+    integrity — for the price of a read. The eval gate never runs, the
+    promoted baseline is untouched, and the audit names the evidence."""
+    _make_gen(lineage, 2)
+    _make_gen(lineage, 4, corrupt=True)
+    seen = []
+    c = _controller(tmp_path, eval_fn=lambda d: seen.append(d) or
+                    _weight_eval(d))
+    try:
+        c.run_once()
+        cand = c.state["candidates"][_gen_name(4)]
+        assert cand["status"] == "rejected"
+        assert cand["rejected_by"] == {"gate": "integrity",
+                                       "reason": "shard_crc"}
+        assert [v["gate"] for v in cand["verdicts"]] == ["integrity"]
+        assert seen == [c.state["candidates"][_gen_name(2)]["dir"]]
+        assert c.state["promoted"]["generation"] == _gen_name(2)
+        rb = [e for e in c._own_recorder.events()
+              if e["kind"] == "deploy_rollback"]
+        assert len(rb) == 1 and rb[0]["gate"] == "integrity"
+        assert rb[0]["reason"] == "shard_crc"
+        assert _counter_values(c.registry, "tdl_deploy_rollbacks_total") \
+            == {("integrity",): 1}
+        audit = json.load(open(c.audit_path))
+        bad = [x for x in audit["candidates"]
+               if x["generation"] == _gen_name(4)][0]
+        assert bad["verdicts"][0]["evidence"]["verify"]["reason"] \
+            == "shard_crc"
+    finally:
+        c.close()
+
+
+def test_loss_spiked_candidate_rejected_at_eval_gate(tmp_path, lineage):
+    """Poison matrix 2: a loss-spiked generation is structurally PERFECT —
+    integrity passes — and only the offline eval gate can reject it
+    (threshold floor AND regression band vs the promoted baseline). The
+    judged numbers land on /metrics under the model label."""
+    _make_gen(lineage, 2)
+    _make_gen(lineage, 4, scale=40.0)
+    c = _controller(tmp_path, regression_band=0.05)
+    try:
+        c.run_once()
+        cand = c.state["candidates"][_gen_name(4)]
+        assert cand["status"] == "rejected"
+        assert cand["rejected_by"]["gate"] == "eval"
+        assert "accuracy" in cand["rejected_by"]["reason"]
+        # integrity PASSED first: the eval gate is the earliest catcher
+        assert [(v["gate"], v["ok"]) for v in cand["verdicts"]] \
+            == [("integrity", True), ("eval", False)]
+        assert c.state["promoted"]["generation"] == _gen_name(2)
+        ev = cand["verdicts"][1]["evidence"]
+        assert ev["metrics"]["accuracy"] == 0.1
+        assert ev["baseline"]["accuracy"] == 0.9
+        acc = _counter_values(c.registry, "tdl_eval_accuracy")
+        assert acc == {(_gen_name(2),): 0.9, (_gen_name(4),): 0.1}
+    finally:
+        c.close()
+
+
+def test_quarantined_candidate_honors_the_evidence(tmp_path, lineage):
+    """A generation the restore side already quarantined (renamed
+    ``*.corrupt``) fails integrity with reason=quarantined — the gate
+    honors the condemnation instead of re-blessing moved bytes."""
+    gendir = _make_gen(lineage, 4)
+    c = _controller(tmp_path)
+    try:
+        os.rename(gendir, gendir + ".corrupt-shard_crc")
+        entry = {"generation": _gen_name(4), "iteration": 4, "dir": gendir,
+                 "verdicts": []}
+        v = c._gate_integrity(entry, {"dir": str(lineage), "quarantined":
+                                      [_gen_name(4) + ".corrupt-shard_crc"]})
+        assert not v["ok"] and v["reason"] == "quarantined"
+        assert v["evidence"]["quarantined"] \
+            == [_gen_name(4) + ".corrupt-shard_crc"]
+    finally:
+        c.close()
+
+
+def test_wedged_gate_times_out_into_rollback(tmp_path, lineage):
+    """Robustness: a gate that never returns hits ``gate_timeout_s`` and
+    becomes a failing verdict (reason=timeout) — the controller never
+    hangs, the candidate rolls back."""
+    _make_gen(lineage, 2)
+    c = _controller(tmp_path, eval_fn=lambda d: time.sleep(60),
+                    gate_timeout_s=0.4)
+    try:
+        c.run_once()
+        cand = c.state["candidates"][_gen_name(2)]
+        assert cand["status"] == "rejected"
+        assert cand["rejected_by"] == {"gate": "eval", "reason": "timeout"}
+    finally:
+        c.close()
+
+
+def test_transient_gate_errors_retry_before_counting(tmp_path, lineage):
+    """Robustness: exceptions escaping a gate fn are transient — retried
+    with backoff. Two flaky failures then success promotes; with retries
+    exhausted the error becomes the verdict."""
+    _make_gen(lineage, 2)
+    calls = []
+
+    def flaky(gendir):
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient fs hiccup")
+        return _weight_eval(gendir)
+
+    c = _controller(tmp_path, eval_fn=flaky, retries=2)
+    try:
+        c.run_once()
+        cand = c.state["candidates"][_gen_name(2)]
+        assert cand["status"] == "promoted" and len(calls) == 3
+        ev = [v for v in cand["verdicts"] if v["gate"] == "eval"][0]
+        assert ev["evidence"]["retries"] == 2
+    finally:
+        c.close()
+
+    def always(gendir):
+        raise OSError("disk on fire")
+
+    _make_gen(lineage, 4)
+    c2 = _controller(tmp_path, workdir=str(tmp_path / "deploy2"),
+                     eval_fn=always, retries=1)
+    try:
+        c2.run_once()
+        cand = c2.state["candidates"][_gen_name(4)]
+        assert cand["status"] == "rejected"
+        assert cand["rejected_by"] == {"gate": "eval",
+                                       "reason": "error:OSError"}
+        ev = cand["verdicts"][-1]["evidence"]
+        assert ev["attempts"] == 2
+    finally:
+        c2.close()
+
+
+# --------------------------------------------------- SIGKILL → resume
+
+
+def test_sigkilled_controller_resumes_to_same_verdict(tmp_path, lineage):
+    """Acceptance: a controller SIGKILLed mid-gate restarts on the same
+    workdir and reaches the same terminal verdict. Gate verdicts recorded
+    before the kill (integrity PASS) are durable and NOT re-run; the
+    candidate resumes at the exact gate it died in."""
+    _make_gen(lineage, 4)
+    cfg = {"ckpt_dir": str(tmp_path / "ck"),
+           "workdir": str(tmp_path / "deploy"),
+           "gates": ["integrity", "eval"],
+           "eval_target": f"{_CTRL_WORKERS}:eval_sleepy",
+           "eval_thresholds": {"accuracy": 0.8},
+           "retries": 0}
+    cfg_path = tmp_path / "controller.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TDL_EVAL_SLEEP="120",
+               TDL_EVAL_ACC="0.9")
+    cmd = [sys.executable, "-m", "deeplearning4j_tpu.deploy.controller",
+           str(cfg_path), "--once"]
+    p1 = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                          stderr=subprocess.PIPE)
+    state_path = tmp_path / "deploy" / "controller_state.json"
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                st = json.loads(state_path.read_text())
+                cand = st["candidates"][_gen_name(4)]
+                if cand["status"] == "in_gate" and cand["gate"] == "eval":
+                    break  # integrity verdict durable, eval gate entered
+            except (OSError, ValueError, KeyError):
+                pass
+            time.sleep(0.05)
+        else:
+            pytest.fail("controller never reached the eval gate")
+        assert [v["gate"] for v in cand["verdicts"]] == ["integrity"]
+    finally:
+        p1.send_signal(signal.SIGKILL)
+        p1.wait(timeout=30)
+
+    env2 = dict(os.environ, JAX_PLATFORMS="cpu", TDL_EVAL_ACC="0.9")
+    p2 = subprocess.run(cmd, env=env2, capture_output=True, text=True,
+                        timeout=300)
+    assert p2.returncode == 0, p2.stderr[-3000:]
+    summary = json.loads(p2.stdout.strip().splitlines()[-1])
+    assert summary["candidates"] == {_gen_name(4): "promoted"}
+    st = json.loads(state_path.read_text())
+    cand = st["candidates"][_gen_name(4)]
+    assert cand["resumed"] is True  # the audit says this verdict survived a death
+    # integrity ran ONCE (before the kill); only eval re-ran after resume
+    assert [v["gate"] for v in cand["verdicts"]] == ["integrity", "eval"]
+    audit = json.loads((tmp_path / "deploy" / "audit.json").read_text())
+    assert audit["promoted"]["generation"] == _gen_name(4)
+
+
+# ------------------------------------------------------- canary gates
+
+
+def _stub_pool(tmp_path, reg, **kw):
+    kw.setdefault("replicas", 1)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    return ServingPool(f"{_POOL_WORKERS}:stub_server",
+                       workdir=str(tmp_path / "pool"), registry=reg, **kw)
+
+
+def test_canary_gate_rejects_latency_injected_candidate(tmp_path, lineage):
+    """Poison matrix 3: a candidate that only misbehaves under LIVE traffic
+    (latency injected into inference whenever TDL_MODEL_CKPT names it)
+    passes integrity and eval, and is caught by the canary SLO gate — the
+    paired replay fires the latency/burn rules for consecutive windows. The
+    canary was router-invisible throughout and the old fleet still serves."""
+    from deeplearning4j_tpu.serving.loadgen import TraceSpec
+
+    gendir = _make_gen(tmp_path / "ck" / "latest", 4)
+    (lineage / "LATEST").write_text(_gen_name(4) + "\n")
+    reg = MetricsRegistry()
+    pool = _stub_pool(tmp_path, reg, extra_env={
+        "TDL_FAULT_SPEC":
+            f"latency_inject@value=0.25,model={_gen_name(4)}"}).start()
+    c = None
+    try:
+        assert pool.wait_ready(60.0)
+        c = _controller(
+            tmp_path, pool=pool, registry=reg,
+            trace=TraceSpec(duration_s=1.5, base_rate=24.0, seed=18),
+            slo_threshold_ms=120.0, burn_window_s=0.5,
+            canary_ready_timeout=60.0)
+        assert c.gates == GATE_CHAIN
+        c.run_once()
+        cand = c.state["candidates"][_gen_name(4)]
+        assert cand["status"] == "rejected", cand
+        assert cand["rejected_by"]["gate"] == "canary"
+        assert cand["rejected_by"]["reason"].startswith("slo:")
+        # caught at the EARLIEST gate that can see it: the first two passed
+        assert [(v["gate"], v["ok"]) for v in cand["verdicts"]] == \
+            [("integrity", True), ("eval", True), ("canary", False)]
+        fired = [v for v in cand["verdicts"]
+                 if v["gate"] == "canary"][0]["evidence"]["fired"]
+        assert fired and all(f["rule"].startswith("canary_") for f in fired)
+        # old fleet untouched: no canary rows left, pool still serves
+        rows = pool.describe()["replicas"]
+        assert all(not r["canary"] for r in rows)
+        assert all(r["model"] is None for r in rows)  # never swapped
+        assert pool.wait_ready(30.0)
+        assert _counter_values(reg, "tdl_deploy_rollbacks_total") \
+            == {("canary",): 1}
+        assert gendir in json.load(open(c.audit_path))["candidates"][0]["dir"]
+    finally:
+        if c is not None:
+            c.close()
+        pool.stop()
+
+
+def test_clean_canary_promotes_and_completes_the_swap(tmp_path, lineage):
+    """The promote leg: a healthy candidate clears the canary window and
+    the controller completes the rolling swap — every replica (and the
+    pool's default overrides, so future scale-ups too) carries the
+    promoted generation."""
+    from deeplearning4j_tpu.serving.loadgen import TraceSpec
+
+    gendir = _make_gen(tmp_path / "ck" / "latest", 6)
+    reg = MetricsRegistry()
+    pool = _stub_pool(tmp_path, reg).start()
+    c = None
+    try:
+        assert pool.wait_ready(60.0)
+        c = _controller(
+            tmp_path, pool=pool, registry=reg,
+            trace=TraceSpec(duration_s=1.5, base_rate=30.0, seed=18),
+            slo_threshold_ms=1000.0, burn_window_s=0.5)
+        c.run_once()
+        cand = c.state["candidates"][_gen_name(6)]
+        assert cand["status"] == "promoted", cand
+        gates = [(v["gate"], v["ok"]) for v in cand["verdicts"]]
+        assert gates == [("integrity", True), ("eval", True),
+                         ("canary", True), ("promote", True)]
+        assert c.state["promoted"]["generation"] == _gen_name(6)
+        rows = pool.describe()["replicas"]
+        assert rows and all(r["model"] == gendir for r in rows)
+        assert all(not r["canary"] for r in rows)
+        assert reg.get("tdl_deploy_promoted_generation").value == 6.0
+        # canary SLO gauges were exercised by the paired judgement
+        assert reg.get("tdl_deploy_canary_availability") is not None
+    finally:
+        if c is not None:
+            c.close()
+        pool.stop()
+
+
+# ------------------------------------------- satellites: eval + swap
+
+
+def test_evaluation_to_metrics_sets_model_gauges():
+    """Satellite: ``Evaluation.to_metrics`` returns the judged numbers AND
+    lands them on the registry under the model label — the eval gate and
+    the /metrics scrape cannot disagree."""
+    from deeplearning4j_tpu.eval import Evaluation, RegressionEvaluation
+
+    reg = MetricsRegistry()
+    ev = Evaluation()
+    y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    p = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]  # 3/4 right
+    ev.eval(y, p)
+    m = ev.to_metrics(reg, model="gen-x")
+    assert m["accuracy"] == pytest.approx(0.75)
+    assert m["score"] == pytest.approx(0.75)
+    assert 0.0 < m["f1"] <= 1.0
+    assert _counter_values(reg, "tdl_eval_accuracy") \
+        == {("gen-x",): pytest.approx(0.75)}
+    assert ("gen-x",) in _counter_values(reg, "tdl_eval_f1")
+
+    rev = RegressionEvaluation()
+    rev.eval(np.asarray([[1.0], [2.0], [3.0]]),
+             np.asarray([[1.1], [1.9], [3.2]]))
+    rm = rev.to_metrics(reg, model="gen-r")
+    assert rm["score"] == pytest.approx(rev.r_squared(0))
+    assert ("gen-r",) in _counter_values(reg, "tdl_eval_score")
+
+
+def test_swap_rejection_names_the_full_verdict(tmp_path):
+    """Satellite: ``swap_model`` pre-flight rejection surfaces the verify
+    verdict — reason, generation, iteration, format — in BOTH the raised
+    error and the ``pool_swap_rejected`` flight payload, not just "no"."""
+    lineage = tmp_path / "ck" / "latest"
+    lineage.mkdir(parents=True)
+    _make_gen(lineage, 3, corrupt=True)
+    rec = FlightRecorder(proc="test", interval=0.0)
+    set_flight_recorder(rec)
+    try:
+        pool = _stub_pool(tmp_path, MetricsRegistry())  # never started
+        with pytest.raises(ValueError) as ei:
+            pool.swap_model(str(tmp_path / "ck"))
+        msg = str(ei.value)
+        assert "reason=shard_crc" in msg
+        assert f"generation={_gen_name(3)}" in msg
+        assert "iteration=3" in msg
+        ev = [e for e in rec.events() if e["kind"] == "pool_swap_rejected"]
+        assert len(ev) == 1
+        assert ev[0]["reason"] == "shard_crc"
+        assert ev[0]["generation"] == _gen_name(3)
+        assert ev[0]["iteration"] == 3
+        assert ev[0]["format"] in ("lineage", "generation")
+        assert ev[0]["verify_seconds"] >= 0
+    finally:
+        set_flight_recorder(None)
+
+
+# -------------------------------------------------- fault vocabulary
+
+
+def test_loss_spike_fault_grammar_and_poison_scale(monkeypatch):
+    """``loss_spike`` parses, fires only at its iteration, and returns the
+    multiplicative scale the trainer applies to its parameter tree."""
+    from deeplearning4j_tpu.common import faults
+
+    fs = faults.parse_fault_spec("loss_spike@iter=4,scale=40")
+    assert fs[0].kind == "loss_spike" and fs[0].iteration == 4
+    monkeypatch.setenv("TDL_FAULT_SPEC", "loss_spike@iter=4,scale=25")
+    monkeypatch.setenv("TDL_GANG_RESTART_COUNT", "0")
+    assert faults.poison_scale("train_step", 3) is None
+    assert faults.poison_scale("train_step", 4) == 25.0
+    assert faults.poison_scale("train_step", 5) is None
+    # one-shot: a restarted incarnation does not re-spike
+    monkeypatch.setenv("TDL_GANG_RESTART_COUNT", "1")
+    assert faults.poison_scale("train_step", 4) is None
+    monkeypatch.delenv("TDL_FAULT_SPEC")
+    assert faults.poison_scale("train_step", 4) is None
+
+
+def test_latency_inject_fires_only_for_the_named_model(monkeypatch):
+    """``latency_inject`` sleeps inside inference batches ONLY in replicas
+    whose TDL_MODEL_CKPT names the poisoned generation — the mechanism that
+    makes a canary slow while the baseline fleet stays fast."""
+    from deeplearning4j_tpu.common import faults
+
+    monkeypatch.setenv("TDL_FAULT_SPEC",
+                       "latency_inject@value=0.15,model=gen-00000008")
+    monkeypatch.delenv("TDL_MODEL_CKPT", raising=False)
+    t0 = time.perf_counter()
+    faults.fault_point("infer")
+    assert time.perf_counter() - t0 < 0.1  # wrong arm: no sleep
+    monkeypatch.setenv("TDL_MODEL_CKPT", "/ck/latest/gen-00000008")
+    t0 = time.perf_counter()
+    faults.fault_point("infer")
+    assert time.perf_counter() - t0 >= 0.15
+
+
+# ---------------------------------------------------- decision lint
+
+
+#: every decision method and the flight event it must record before any
+#: non-delegated return path
+_DECISION_EVENTS = {"_announce_candidate": "deploy_candidate",
+                    "_record_verdict": "deploy_gate",
+                    "_promote": "deploy_promote",
+                    "_rollback": "deploy_rollback"}
+
+
+def _record_kind_literals(node):
+    out = []
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "record"
+                and sub.args
+                and isinstance(sub.args[0], ast.Constant)
+                and isinstance(sub.args[0].value, str)):
+            out.append((sub.args[0].value, sub.lineno))
+    return out
+
+
+def _unflighted_decision_paths(tree):
+    """Return paths in controller decision methods that could complete
+    without the decision's flight event: [(method, lineno, why)]. A return
+    that DELEGATES to another decision method (``return self._rollback(...)``)
+    is flighted transitively and exempt."""
+    offenders = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name in _DECISION_EVENTS):
+            continue
+        want = _DECISION_EVENTS[node.name]
+        record_lines = [ln for kind, ln in _record_kind_literals(node)
+                        if kind == want]
+        if not record_lines:
+            offenders.append((node.name, node.lineno, f"never records "
+                              f"{want!r}"))
+            continue
+        first = min(record_lines)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Return) or sub.lineno >= first:
+                continue
+            v = sub.value
+            if (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+                    and v.func.attr in _DECISION_EVENTS):
+                continue
+            offenders.append((node.name, sub.lineno,
+                              f"returns before recording {want!r}"))
+    return offenders
+
+
+def test_controller_decisions_are_flighted():
+    """CI lint (satellite): every promote / rollback / gate-verdict /
+    candidate decision path in controller.py records its flight event (from
+    the declared kind set) before returning — an unattended controller whose
+    decisions don't reach the audit trail is a silent operator."""
+    src = (ROOT / "deeplearning4j_tpu" / "deploy" / "controller.py")
+    tree = ast.parse(src.read_text(), filename=str(src))
+    assert _unflighted_decision_paths(tree) == []
+    # and every kind used is registered in the flight schema
+    for kind, _ in _record_kind_literals(tree):
+        assert kind in EVENT_KINDS, kind
+    found = {n.name for n in ast.walk(tree)
+             if isinstance(n, ast.FunctionDef)}
+    assert set(_DECISION_EVENTS) <= found  # the lint actually saw them
+
+
+def test_decision_lint_catches_a_planted_offender():
+    """The lint must bite: a decision method with an early bare return (or
+    no record at all) is flagged; the delegated-return idiom passes."""
+    planted = ast.parse(
+        "class X:\n"
+        "    def _promote(self, entry):\n"
+        "        if entry is None:\n"
+        "            return None\n"  # escapes without the event: offender
+        "        flight.record('deploy_promote', generation='g')\n"
+        "        return entry\n"
+        "    def _rollback(self, entry, verdict):\n"
+        "        return None\n"  # no event at all: offender
+        "    def _record_verdict(self, entry, verdict):\n"
+        "        if verdict is None:\n"
+        "            return self._rollback(entry, verdict)\n"  # delegated: ok
+        "        flight.record('deploy_gate', gate='eval')\n"
+        "        return verdict\n")
+    bad = _unflighted_decision_paths(planted)
+    assert [(m, why.split(" ")[0]) for m, _, why in bad] \
+        == [("_promote", "returns"), ("_rollback", "never")]
+
+
+# ------------------------------------------------------ e2e (slow)
+
+
+@pytest.mark.slow
+def test_e2e_chaos_train_gate_promote_unattended(tmp_path):
+    """ISSUE 18 acceptance: train a model under injected chaos (a rank
+    crash mid-run), let the lineage commit generations — three of them
+    poisoned (bit-flipped, latency-injected, loss-spiked) — then run the
+    controller unattended against a live pool under replayed traffic.
+    Each poison must be rejected at the EARLIEST gate that can catch it,
+    with gate/reason/evidence in audit.json mirrored as deploy_rollback
+    flight events, one healthy generation must auto-promote through the
+    canary, and only 200/429 ever escape the pool."""
+    from deeplearning4j_tpu.parallel.supervisor import GangSupervisor
+    from deeplearning4j_tpu.serving.loadgen import TraceSpec, replay
+    from tests.controller_workers import eval_candidate
+
+    ckroot = tmp_path / "ck"
+    ckroot.mkdir()
+    env = {"TDL_MP_CKPT": str(ckroot), "TDL_MP_STEPS": "12",
+           "TDL_MP_CKPT_EVERY": "3",
+           "TDL_MP_OUT": str(tmp_path / "out.json"),
+           "TDL_MATMUL_PRECISION": "float32",
+           # chaos: rank 1 dies at iter 7 (restart resumes from gen 6);
+           # the restarted incarnation hits a loss spike at iter 11, so
+           # gen-12 commits structurally perfect but ruined weights
+           "TDL_FAULT_SPEC": "crash@iter=7,rank=1;"
+                             "loss_spike@iter=11,scale=60,restart=1"}
+    sup = GangSupervisor(f"{_CTRL_WORKERS}:lifecycle_train", n_processes=2,
+                         n_local_devices=2, extra_env=env,
+                         workdir=str(tmp_path / "gang"),
+                         heartbeat_interval=0.0, backoff_base=0.1,
+                         kill_grace=1.0, max_restarts=3,
+                         registry=MetricsRegistry())
+    results = sup.run(timeout=540.0)
+    for r in results:
+        assert r.returncode == 0, f"rank {r.rank} failed:\n{r.stderr[-3000:]}"
+    assert sup.restarts >= 1  # the crash chaos really happened
+
+    lineage = ckroot / "latest"
+    gens = sorted(d for d in os.listdir(lineage) if d.startswith("gen-")
+                  and not d.endswith("corrupt"))
+    # gens at iterations 3, 6, 9, 12 (every=3 over 12 steps)
+    assert [int(g.split("-")[1].rstrip("abcdefghijklmnopqrstuvwxyz"))
+            for g in gens][-4:] == [3, 6, 9, 12]
+    g3, g6, g9, g12 = gens[-4:]
+
+    # poison 1 (bit-rot): flip a byte in gen-6's committed shard
+    shard = lineage / g6 / "shard_0.npz"
+    raw = bytearray(shard.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    shard.write_bytes(bytes(raw))
+
+    reg = MetricsRegistry()
+    # poison 2 (latency): replicas serving gen-9 sleep inside inference
+    pool = ServingPool(f"{_POOL_WORKERS}:stub_server", replicas=2,
+                       min_replicas=1, max_replicas=4,
+                       workdir=str(tmp_path / "pool"), registry=reg,
+                       extra_env={"TDL_FAULT_SPEC":
+                                  f"latency_inject@value=0.5,model={g9}"}
+                       ).start()
+    c = None
+    try:
+        assert pool.wait_ready(60.0)
+        c = FleetController(
+            str(ckroot), pool, workdir=str(tmp_path / "deploy"),
+            eval_fn=eval_candidate, eval_thresholds={"score": 0.3},
+            regression_band=0.15,
+            trace=TraceSpec(duration_s=2.0, base_rate=30.0, seed=18),
+            slo_threshold_ms=200.0, burn_window_s=0.5,
+            retries=1, retry_backoff_s=0.1, registry=reg)
+        c.run_once()
+
+        cands = c.state["candidates"]
+        # the healthy first generation promoted through the full chain...
+        assert cands[g3]["status"] == "promoted"
+        assert c.state["promoted"]["generation"] == g3
+        # ...and each poison died at the EARLIEST gate that can catch it
+        assert cands[g6]["rejected_by"]["gate"] == "integrity"
+        assert cands[g9]["rejected_by"]["gate"] == "canary"
+        assert cands[g9]["rejected_by"]["reason"].startswith("slo:")
+        assert cands[g12]["rejected_by"]["gate"] == "eval"
+        spiked = [v for v in cands[g12]["verdicts"] if v["gate"] == "eval"]
+        healthy = [v for v in cands[g3]["verdicts"] if v["gate"] == "eval"]
+        assert spiked[0]["evidence"]["metrics"]["score"] \
+            < healthy[0]["evidence"]["metrics"]["score"] - 0.15
+
+        # audit mirrors every rejection with gate + reason + evidence
+        audit = json.load(open(c.audit_path))
+        by_gen = {x["generation"]: x for x in audit["candidates"]}
+        for g, gate in ((g6, "integrity"), (g9, "canary"), (g12, "eval")):
+            bad = [v for v in by_gen[g]["verdicts"] if not v["ok"]]
+            assert bad and bad[-1]["gate"] == gate
+            assert bad[-1]["evidence"]
+        rb = {e["generation"]: e for e in c._own_recorder.events()
+              if e["kind"] == "deploy_rollback"} if c._own_recorder else {}
+        # the controller self-records when unsupervised; either way the
+        # rollback counters saw all three gates
+        assert _counter_values(reg, "tdl_deploy_rollbacks_total") == {
+            ("integrity",): 1, ("canary",): 1, ("eval",): 1}
+        assert _counter_values(reg, "tdl_deploy_promotions_total") == {(): 1}
+
+        # the promoted fleet serves the replayed traffic with only
+        # 200/429 escaping the pool's front door
+        rows = pool.describe()["replicas"]
+        assert all(r["model"] and r["model"].endswith(g3) for r in rows)
+        report = replay(TraceSpec(duration_s=2.0, base_rate=40.0, seed=7),
+                        pool.port, n_clients=4,
+                        payload=[[0.0, 0.0, 0.0, 0.0]])
+        assert set(report["outcomes"]) <= {"200", "429"}
+        assert report["outcomes"].get("200", 0) > 0
+        assert audit["timeline"] and os.path.exists(audit["timeline"])
+    finally:
+        if c is not None:
+            c.close()
+        pool.stop()
