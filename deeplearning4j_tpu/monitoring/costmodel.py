@@ -161,6 +161,66 @@ def cost_table(rows: List[dict], xla: Optional[dict] = None) -> dict:
     return table
 
 
+# ------------------------------------------------- pipeline stage balancing
+
+
+def balance_stages(costs: List[float], n_stages: int) -> List[tuple]:
+    """Min-max contiguous partition of per-layer ``costs`` into ``n_stages``
+    stages (ISSUE 19): the classic linear-partition DP — O(L²·S) over host
+    floats, exact, deterministic. Returns ``[(start, end), ...]`` half-open
+    layer ranges, one per stage, every stage non-empty, covering [0, L).
+
+    This is THE stage-boundary authority: pipeline wiring must take its
+    boundaries from here (or an explicit argument a caller computed), never
+    from hardcoded layer indices — the stage-boundary AST lint in
+    tests/test_pipeline_parallel.py enforces the rule.
+    """
+    L, S = len(costs), int(n_stages)
+    if S < 1:
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if L < S:
+        raise ValueError(
+            f"cannot split {L} layers into {S} non-empty pipeline stages")
+    c = [float(x) for x in costs]
+    if any(x < 0 for x in c):
+        raise ValueError(f"negative layer cost in {c}")
+    prefix = [0.0]
+    for x in c:
+        prefix.append(prefix[-1] + x)
+
+    def span(i, j):  # cost of layers [i, j)
+        return prefix[j] - prefix[i]
+
+    # best[s][j] = minimal max-stage-cost splitting layers [0, j) into s+1
+    # stages; cut[s][j] = where the last stage starts in that optimum
+    best = [[float("inf")] * (L + 1) for _ in range(S)]
+    cut = [[0] * (L + 1) for _ in range(S)]
+    for j in range(1, L + 1):
+        best[0][j] = span(0, j)
+    for s in range(1, S):
+        for j in range(s + 1, L + 1):
+            for i in range(s, j):
+                cand = max(best[s - 1][i], span(i, j))
+                # strict < keeps the EARLIEST optimal cut → deterministic
+                # boundaries for identical cost tables across ranks
+                if cand < best[s][j]:
+                    best[s][j] = cand
+                    cut[s][j] = i
+    bounds = []
+    j = L
+    for s in range(S - 1, -1, -1):
+        i = cut[s][j] if s else 0
+        bounds.append((i, j))
+        j = i
+    return list(reversed(bounds))
+
+
+def stage_costs(costs: List[float], boundaries: List[tuple]) -> List[float]:
+    """Total predicted cost per stage for ``boundaries`` over per-layer
+    ``costs`` — the prediction side of the measured-skew rebalance loop."""
+    return [float(sum(costs[a:b])) for a, b in boundaries]
+
+
 # --------------------------------------------------------------- XLA ground
 
 

@@ -74,6 +74,9 @@ EVENT_KINDS = frozenset({
     "request_span", "route", "queue_hwm",
     # gang supervisor
     "gang_failure", "restart_decision", "gang_resize",
+    # pipeline parallelism (ISSUE 19): a measured-skew stage re-partition,
+    # naming the old and new stage boundaries
+    "pipe_rebalance",
     # serving pool
     "pool_scale", "pool_swap_rejected", "pool_swap_begin", "pool_swap",
     "pool_swap_rollback", "replica_spawn", "replica_retire",

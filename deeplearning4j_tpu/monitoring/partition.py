@@ -25,6 +25,19 @@ resize it enables)::
                                         restore (per restore() call)
     tdl_gang_resizes_total{direction}   GangSupervisor elastic resizes to the
                                         surviving healthy ranks
+
+Pipeline-parallel families (ISSUE 19 — the ``pipe`` axis)::
+
+    tdl_pipe_stages                     stages in the active pipeline layout
+    tdl_pipe_bubble_fraction{schedule}  measured idle fraction of the
+                                        microbatch schedule (analytic bound
+                                        is (S-1)/(M+S-1))
+    tdl_pipe_stage_seconds{stage}       measured per-stage forward seconds —
+                                        compare against the cost-model
+                                        prediction to see stage skew
+    tdl_pipe_rebalances_total           measured-skew stage re-partitions
+                                        (each also records a
+                                        ``pipe_rebalance`` flight event)
 """
 
 from __future__ import annotations
@@ -47,6 +60,32 @@ def partition_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNames
             "tdl_mesh_layout_info",
             "active data/fsdp/tp mesh layout; value = mesh device count",
             labels=("data", "fsdp", "tp")),
+    )
+
+
+def pipe_metrics(registry: Optional[MetricsRegistry] = None) -> SimpleNamespace:
+    """Get-or-create the pipeline-parallel families (ISSUE 19): stage count,
+    measured schedule bubble, per-stage seconds, and the rebalance counter
+    the measured-skew loop increments."""
+    r = registry if registry is not None else get_registry()
+    return SimpleNamespace(
+        stages=r.gauge(
+            "tdl_pipe_stages",
+            "pipeline stages in the active pipe layout"),
+        bubble=r.gauge(
+            "tdl_pipe_bubble_fraction",
+            "measured pipeline bubble (idle) fraction of one step, by "
+            "microbatch schedule; the fill-drain analytic bound is "
+            "(S-1)/(M+S-1)", labels=("schedule",)),
+        stage_seconds=r.gauge(
+            "tdl_pipe_stage_seconds",
+            "measured per-stage forward wall seconds (stage skew vs the "
+            "tdl_layer_cost_info prediction drives rebalancing)",
+            labels=("stage",)),
+        rebalances=r.counter(
+            "tdl_pipe_rebalances_total",
+            "cost-model stage re-partitions triggered by measured stage "
+            "skew exceeding the rebalance threshold"),
     )
 
 

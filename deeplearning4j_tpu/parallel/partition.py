@@ -40,7 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nn.conf import (ROLE_BIAS, ROLE_EMBEDDING, ROLE_KERNEL, ROLE_NORM,
                        classify_param_tree)
-from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_TP, mesh_from_shape
+from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_TP, mesh_from_shape
 
 ROLES = (ROLE_EMBEDDING, ROLE_KERNEL, ROLE_NORM, ROLE_BIAS)
 
@@ -63,20 +63,34 @@ class SpecLayout:
     A dim that an axis does not divide falls back per-axis (see
     :meth:`Partitioner.spec_tree`) — same "shard what fits" behavior GSPMD
     applies to activations — so a 3-class head never wedges a layout.
+
+    ``pipe`` (ISSUE 19) adds the depth axis: layer stacks are partitioned
+    into ``pipe`` stages, each stage owning a contiguous block of layers
+    (and their optimizer slots). A ``pipe=1`` layout keeps the exact
+    pre-pipe mesh/describe() identity, so existing checkpoints and gangs
+    see no change; ``pipe>1`` puts the pipe axis OUTERMOST (stage hops are
+    the rarest collective — one activation ppermute per microbatch tick).
     """
 
     data: int = 1
     fsdp: int = -1
     tp: int = 1
+    pipe: int = 1
     data_axis: str = AXIS_DATA
     fsdp_axis: str = AXIS_FSDP
     tp_axis: str = AXIS_TP
+    pipe_axis: str = AXIS_PIPE
 
     # ------------------------------------------------------------------ mesh
 
     def shape(self) -> Dict[str, int]:
-        return {self.data_axis: self.data, self.fsdp_axis: self.fsdp,
+        base = {self.data_axis: self.data, self.fsdp_axis: self.fsdp,
                 self.tp_axis: self.tp}
+        if self.pipe != 1:
+            # pipe outermost; omitted entirely at size 1 so pipe-less
+            # layouts keep their exact historical mesh + manifest identity
+            return {self.pipe_axis: self.pipe, **base}
+        return base
 
     def build_mesh(self, devices: Optional[Sequence] = None) -> Mesh:
         return mesh_from_shape(self.shape(), devices=devices)
@@ -119,26 +133,40 @@ class SpecLayout:
         layouts compare equal iff a checkpoint written under one restores
         shard-for-shard under the other."""
         sizes = dict(mesh.shape) if mesh is not None else self.shape()
-        return {"axes": {"data": int(sizes.get(self.data_axis, self.data)),
-                         "fsdp": int(sizes.get(self.fsdp_axis, self.fsdp)),
-                         "tp": int(sizes.get(self.tp_axis, self.tp))},
-                "axis_names": [self.data_axis, self.fsdp_axis, self.tp_axis]}
+        out = {"axes": {"data": int(sizes.get(self.data_axis, self.data)),
+                        "fsdp": int(sizes.get(self.fsdp_axis, self.fsdp)),
+                        "tp": int(sizes.get(self.tp_axis, self.tp))},
+               "axis_names": [self.data_axis, self.fsdp_axis, self.tp_axis]}
+        pipe = int(sizes.get(self.pipe_axis, self.pipe))
+        if pipe != 1:
+            # pipe-less layouts keep the exact historical (3-axis) identity
+            # so every pre-pipe checkpoint still compares equal on restore
+            out["axes"]["pipe"] = pipe
+            out["axis_names"] = [self.pipe_axis] + out["axis_names"]
+        return out
 
 
-def largest_layout(n_devices: int, tp: int = 1, data: int = 1) -> SpecLayout:
+def largest_layout(n_devices: int, tp: int = 1, data: int = 1,
+                   pipe: int = 1) -> SpecLayout:
     """The largest valid :class:`SpecLayout` for a device count (ISSUE 14 —
     what an elastically-resized gang builds for its survivor count): ``fsdp``
-    absorbs every device not claimed by ``data``/``tp``; a requested
-    ``data``/``tp`` that does not divide falls back to its largest feasible
-    divisor, never an invalid mesh."""
+    absorbs every device not claimed by ``pipe``/``data``/``tp``; a requested
+    ``pipe``/``data``/``tp`` that does not divide falls back to its largest
+    feasible divisor, never an invalid mesh. ``pipe`` is claimed FIRST — a
+    resized gang keeps its stage count whenever the survivors can still hold
+    it (ISSUE 19: the re-partitioned stages restore cross-topology)."""
     n = max(1, int(n_devices))
+    pipe = max(1, int(pipe))
+    while n % pipe:
+        pipe -= 1
+    rest = n // pipe
     data = max(1, int(data))
-    while n % data:
+    while rest % data:
         data -= 1
-    tp = max(1, min(int(tp), n // data))
-    while (n // data) % tp:
+    tp = max(1, min(int(tp), rest // data))
+    while (rest // data) % tp:
         tp -= 1
-    return SpecLayout(data=data, fsdp=n // (data * tp), tp=tp)
+    return SpecLayout(data=data, fsdp=rest // (data * tp), tp=tp, pipe=pipe)
 
 
 # ------------------------------------------------------------------ role trees
@@ -224,7 +252,10 @@ class Partitioner:
         self.layout = layout
         self.mesh = mesh if mesh is not None else layout.build_mesh()
         self.strict = strict
-        for ax in (layout.data_axis, layout.fsdp_axis, layout.tp_axis):
+        axes = [layout.data_axis, layout.fsdp_axis, layout.tp_axis]
+        if layout.pipe != 1:
+            axes.insert(0, layout.pipe_axis)
+        for ax in axes:
             if ax not in self.mesh.shape:
                 raise ValueError(
                     f"mesh {dict(self.mesh.shape)} lacks layout axis {ax!r}")
@@ -390,6 +421,57 @@ class Partitioner:
             per_device_params_bytes=max(per_dev.values(), default=per_rank),
             uncovered=list(uncovered), replicated_fallback=list(fallback),
             specs=specs)
+
+
+class PipelinePartitioner(Partitioner):
+    """Partitioner for the CANONICAL pipeline train state (ISSUE 19).
+
+    The pipeline trainer keeps params in canonical form — ``{"embed": ...,
+    "blocks": <stacked leaves, leading dim = n_layers>, "mlm": ...}`` — and
+    builds the per-stage view INSIDE the compiled step (a static gather the
+    cost partitioner's boundaries parameterize). Storage therefore shards on
+    the LAYER dim: over ``pipe`` when the layout has one (each stage's HBM
+    holds only its own layers + optimizer slots), else over ``fsdp`` (the
+    same leading-dim chunks — which is exactly why a ``pipe=2`` checkpoint
+    restores onto an ``fsdp=2`` layout bitwise through the chunk-intersection
+    reshard path). ``embed``/``mlm`` replicate (small; GSPMD dp-shards their
+    compute via the batch).
+
+    Role classification is bypassed on purpose: the canonical tree's layout
+    contract is positional (dim 0 = layer), not role-shaped, and the ONE
+    describe()/state_specs surface the checkpoint lineage consumes is
+    inherited unchanged from :class:`Partitioner`.
+    """
+
+    BLOCKS_KEY = "blocks"
+
+    def _depth_axis(self) -> str:
+        return (self.layout.pipe_axis if self.layout.pipe != 1
+                else self.layout.fsdp_axis)
+
+    def spec_tree(self, params, roles: Optional[Any] = None,
+                  report: Optional[dict] = None) -> Any:
+        ax = self._depth_axis()
+
+        def leaf_spec(in_blocks: bool, leaf) -> P:
+            ndim = int(np.ndim(leaf))
+            if not in_blocks or ndim == 0:
+                return P()
+            return self._trim(np.shape(leaf), P(ax, *([None] * (ndim - 1))))
+
+        def walk(p, in_blocks):
+            if isinstance(p, dict):
+                return {k: walk(v, in_blocks or k == self.BLOCKS_KEY)
+                        for k, v in p.items()}
+            if isinstance(p, (list, tuple)):
+                return type(p)(walk(v, in_blocks) for v in p)
+            return leaf_spec(in_blocks, p)
+
+        specs = walk(params, False)
+        if report is not None:
+            report["uncovered"] = []
+            report["replicated_fallback"] = []
+        return specs
 
 
 # ------------------------------------------------------------------- helpers

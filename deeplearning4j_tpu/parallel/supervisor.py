@@ -223,6 +223,7 @@ class GangSupervisor:
         same_iteration_fatal: int = 3,
         elastic: bool = False,
         min_processes: int = 1,
+        pipe_stages: int = 1,
         ckpt_dir: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
     ):
@@ -256,6 +257,12 @@ class GangSupervisor:
         self.same_iteration_fatal = max(2, same_iteration_fatal)
         self.elastic = elastic
         self.min_processes = max(1, min_processes)
+        #: preferred pipeline depth for elastic survivor layouts (ISSUE 19):
+        #: a resized gang re-partitions its stages over at most this many
+        #: pipe shards (largest_layout degrades it until it divides the
+        #: surviving device count) and restores cross-topology via
+        #: reshard=True — pipe and fsdp chunk the same leading layer dim
+        self.pipe_stages = max(1, pipe_stages)
         #: checkpoint lineage root the workers save/restore under (ISSUE 15)
         #: — when set, every postmortem carries a ``checkpoint`` section
         #: with the lineage inventory (committed/torn/quarantined, pointer)
@@ -740,7 +747,8 @@ class GangSupervisor:
             return False
         from .partition import largest_layout
 
-        layout = largest_layout(new_n * self.n_local_devices)
+        layout = largest_layout(new_n * self.n_local_devices,
+                                pipe=self.pipe_stages)
         entry = {
             "direction": "down",
             "from_processes": self.n_processes,

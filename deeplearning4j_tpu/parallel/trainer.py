@@ -66,6 +66,10 @@ class ParallelTrainer:
     partial gradients + ICI allreduce automatically.
     """
 
+    #: whether this trainer runs the microbatch schedule a SpecLayout pipe
+    #: axis implies; parallel.pipeline.PipelineParallelTrainer flips it
+    _supports_pipe = False
+
     def __init__(self, net, mesh: Optional[Mesh] = None, data_axis: str = AXIS_DATA,
                  sharding_rules=None, mesh_layout=None, bucketing=None):
         # persistent executable cache (ISSUE 12): a respawned gang rank
@@ -103,6 +107,16 @@ class ParallelTrainer:
             mesh = mesh_layout.mesh
             data_axis = mesh_layout.layout.data_axis
             self.partitioner = mesh_layout
+            if (getattr(mesh_layout.layout, "pipe", 1) != 1
+                    and not self._supports_pipe):
+                # a pipe axis silently treated as extra data/fsdp parallelism
+                # would train wrong — only the pipeline trainer runs the
+                # microbatch schedule the axis implies
+                raise ValueError(
+                    f"mesh_layout has a pipe axis (pipe="
+                    f"{mesh_layout.layout.pipe}) but {type(self).__name__} "
+                    "runs no pipeline schedule — use "
+                    "parallel.pipeline.PipelineParallelTrainer")
         self.mesh = mesh or build_mesh(**{data_axis: -1})
         self.data_axis = data_axis
         # VERDICT r2: nets can now train tensor-parallel through the standard

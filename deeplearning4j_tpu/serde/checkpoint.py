@@ -221,8 +221,11 @@ def _fmt_layout(layout) -> str:
     if not layout:
         return "replicated (no mesh layout)"
     ax = layout.get("axes", {})
-    return (f"data={ax.get('data')} x fsdp={ax.get('fsdp')} "
-            f"x tp={ax.get('tp')}")
+    out = (f"data={ax.get('data')} x fsdp={ax.get('fsdp')} "
+           f"x tp={ax.get('tp')}")
+    if ax.get("pipe", 1) != 1:  # pipe-sharded layouts (ISSUE 19)
+        out = f"pipe={ax.get('pipe')} x " + out
+    return out
 
 
 def _spec_paths(tree, prefix=""):
