@@ -181,6 +181,10 @@ def _scale(on_tpu):
                             replicas=2),
             "ckpt_lineage": dict(features=256, hidden=2048, classes=32,
                                  steps=3, saves=4),
+            # gangs run platform="cpu" regardless of backend: the sweep
+            # prices fleet orchestration, not device math
+            "hpo": dict(trials=8, rungs=(4, 8), concurrent=4, seed=7,
+                        resume_trials=3, etl_images=48, etl_iters=3),
             "deploy": dict(features=256, hidden=2048, classes=32, steps=3,
                            canary_requests=2000),
             "compile_cache": dict(features=64, classes=8, batch_limit=16,
@@ -232,6 +236,8 @@ def _scale(on_tpu):
                         replicas=2),
         "ckpt_lineage": dict(features=32, hidden=256, classes=8, steps=2,
                              saves=3),
+        "hpo": dict(trials=4, rungs=(2, 4), concurrent=4, seed=7,
+                    resume_trials=3, etl_images=32, etl_iters=2),
         "deploy": dict(features=32, hidden=256, classes=8, steps=2,
                        canary_requests=400),
         "compile_cache": dict(features=16, classes=4, batch_limit=8,
@@ -2569,10 +2575,266 @@ def bench_trace_overhead(p):
             "span_sample_n": 1, "target_pct": 2.0}
 
 
+# ------------------------------------------------------------------ hpo fleet
+
+
+def bench_hpo(p):
+    """ISSUE 20: the price of a fault-isolated PBT/ASHA sweep, itemized.
+
+    - ``sweep_s`` vs ``sequential_s`` / ``speedup``: the same N-trial gang
+      sweep (real ``GangSupervisor`` gangs over the synth task, one shared
+      spool/flight/compile-cache plane) run at ``max_concurrent=K`` against
+      one-gang-at-a-time — what the fleet's concurrency is worth at the
+      wall clock, per-gang spawn cost included;
+    - ``clone_verify_ms`` / ``clone_fallback_ms``: one PBT exploit through
+      the REAL fleet path (suffixed-sibling re-save of the winner's newest
+      committed generation: deep verify + commit + journal + loser-lineage
+      retire), then the same exploit with that generation bit-flipped —
+      quarantine the corrupt commit, fall back one generation;
+    - ``resume``: SIGKILL the unattended fleet CLI mid-rung, rerun the same
+      config, time to a winner — journaled scores are adopted, not re-run;
+    - ``etl_cache``: two ``lenet_images`` trials sharing one
+      ``DecodedBatchCache`` — the sweep pays the PNG decode once (first
+      trial's misses), every later trial memmaps it (hits), read per trial
+      from the merged worker spool.
+
+    Phase 0 drives an in-process micro-fleet through every trial-terminal
+    decision path (promote / demote / clone / quarantine) on the PROCESS
+    registry, so the ``tdl_trial_*`` / ``tdl_fleet_*`` families are hot for
+    ``--check-telemetry`` without waiting on real gangs."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from PIL import Image
+
+    from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                            IntegerParameterSpace,
+                                            RandomSearchGenerator)
+    from deeplearning4j_tpu.arbiter.fleet import GangTrialRunner, TrialFleet
+    from deeplearning4j_tpu.common.faults import _flip_bit_in_shard
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.monitoring import MetricsRegistry, aggregate
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serde.checkpoint import (TrainingCheckpointer,
+                                                     lineage_state)
+
+    spaces = {
+        "learning_rate": ContinuousParameterSpace(1e-3, 1e-1, log_scale=True),
+        "hidden": IntegerParameterSpace(4, 32),
+    }
+    spaces_cfg = {
+        "learning_rate": {"kind": "continuous", "lo": 1e-3, "hi": 1e-1,
+                          "log_scale": True},
+        "hidden": {"kind": "integer", "lo": 4, "hi": 32},
+    }
+    task = {"kind": "synth_classify", "seed": 11}
+
+    def build_small_net(seed=5):
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def seed_lineage(directory, steps=2, seed=5):
+        # a real committed lineage for PBT to clone from (the in-process
+        # phases skip gang training but never fake checkpoint bytes)
+        net = build_small_net(seed)
+        rs = np.random.RandomState(0)
+        x = rs.randn(16, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 16)]
+        ck = TrainingCheckpointer(directory, async_write=False, keep_last=8)
+        for _ in range(steps):
+            net._fit_batch(DataSet(x, y))
+            ck.save(net)
+
+    def micro_runner(slot, target_iter, timeout_s):
+        if slot.trial_id == "t05":
+            raise RuntimeError("chaos: injected trial crash")
+        lr = float(slot.hparams["learning_rate"])
+        return 1.0 / (1.0 + abs(np.log10(lr) + 2.0)) + 1e-3 * target_iter
+
+    out = {"metric": "hpo_sweep_speedup", "unit": "x",
+           "trials": p["trials"], "rungs": list(p["rungs"]),
+           "concurrent": p["concurrent"]}
+    tmp = tempfile.mkdtemp(prefix="bench_hpo_")
+    try:
+        # (0) decision-path micro-fleet on the process registry: one trial
+        # crashes past its restart budget (quarantine), the ASHA cut
+        # demotes, PBT clones the seeded winner lineage (ok outcome)
+        fleet = TrialFleet(
+            RandomSearchGenerator(spaces, seed=3), micro_runner,
+            workdir=os.path.join(tmp, "micro"), n_trials=6, rungs=(1, 2),
+            reduction=2, pbt=True, pbt_quantile=0.34, seed=3,
+            trial_max_restarts=1, backoff_base_s=0.01, backoff_max_s=0.02,
+            max_concurrent=4, rung_timeout_s=120.0, spaces=spaces)
+        for tid, slot in fleet.trials.items():
+            if tid != "t05":
+                seed_lineage(slot.ckpt_dir)
+        try:
+            micro_winner = fleet.run()
+        finally:
+            fleet.close()
+        out["micro"] = {
+            "winner": micro_winner["trial"],
+            "quarantined": sorted(t.trial_id for t in fleet.trials.values()
+                                  if t.status == "quarantined"),
+            "clones": [r["outcome"] for r in fleet.state["journal"]
+                       if r["kind"] == "clone"]}
+
+        # (1) clone + deep-verify latency through the real fleet path, then
+        # the same exploit against a bit-flipped newest generation — the
+        # quarantine-and-fall-back-one-commit price
+        cfleet = TrialFleet(
+            RandomSearchGenerator(spaces, seed=9), micro_runner,
+            workdir=os.path.join(tmp, "clone"), n_trials=2, rungs=(1,),
+            pbt=False, seed=9, spaces=spaces)
+        winner, loser = cfleet.trials["t00"], cfleet.trials["t01"]
+        seed_lineage(winner.ckpt_dir, steps=2, seed=5)
+        seed_lineage(loser.ckpt_dir, steps=1, seed=7)
+        t0 = time.perf_counter()
+        got = cfleet._clone_into_slot(loser, winner, rung=0)
+        out["clone_verify_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        assert got == "ok", got
+        newest = lineage_state(winner.ckpt_dir)["newest_committed"]
+        assert _flip_bit_in_shard(
+            os.path.join(winner.ckpt_dir, "latest", newest)) is not None
+        t0 = time.perf_counter()
+        got = cfleet._clone_into_slot(loser, winner, rung=0)
+        out["clone_fallback_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        assert got == "fallback", got
+        cfleet.close()
+
+        # (2) the sweep itself: real gangs, concurrent vs one-at-a-time.
+        # Same generator seed → identical candidate sets; the sequential
+        # baseline keeps its metrics off the process registry so the
+        # telemetry block reflects the concurrent sweep
+        def gang_sweep(wd, max_concurrent, registry=None):
+            gen = RandomSearchGenerator(spaces, seed=p["seed"])
+            runner = GangTrialRunner(wd, task, hang_timeout=60.0)
+            fl = TrialFleet(
+                gen, runner, workdir=wd, n_trials=p["trials"],
+                rungs=tuple(p["rungs"]), reduction=2, pbt=True,
+                seed=p["seed"], registry=registry, rung_timeout_s=900.0,
+                trial_max_restarts=1, backoff_base_s=0.1,
+                max_concurrent=max_concurrent)
+            t0 = time.perf_counter()
+            try:
+                win = fl.run()
+            finally:
+                fl.close()
+            return time.perf_counter() - t0, win
+
+        sweep_s, win = gang_sweep(os.path.join(tmp, "sweep"),
+                                  p["concurrent"])
+        seq_s, _ = gang_sweep(os.path.join(tmp, "seq"), 1,
+                              registry=MetricsRegistry())
+        out["sweep_s"] = round(sweep_s, 2)
+        out["sequential_s"] = round(seq_s, 2)
+        out["speedup"] = round(seq_s / max(sweep_s, 1e-9), 2)
+        out["value"] = out["speedup"]
+        out["winner"] = {"trial": win["trial"],
+                         "score": round(win["score"], 4)}
+
+        # (3) SIGKILL the unattended CLI mid-rung, rerun the same config:
+        # resume adopts the journaled scores instead of re-running them
+        resume_wd = os.path.join(tmp, "resume")
+        cfg_path = os.path.join(tmp, "resume_cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump({"workdir": resume_wd, "generator": "random",
+                       "seed": 13, "n_trials": p["resume_trials"],
+                       "rungs": [p["rungs"][0]], "max_concurrent": 1,
+                       "pbt": False, "rung_timeout_s": 600.0,
+                       "trial_max_restarts": 1, "backoff_base_s": 0.1,
+                       "hang_timeout": 60.0, "task": task,
+                       "spaces": spaces_cfg}, f)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        cli = [sys.executable, "-m", "deeplearning4j_tpu.arbiter.fleet",
+               cfg_path]
+        proc = subprocess.Popen(cli, env=env, cwd=str(_HERE),
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        state_path = os.path.join(resume_wd, "fleet_state.json")
+        deadline = time.monotonic() + 300.0
+        killed, pre_scores = False, 0
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                rows = json.load(open(state_path))["journal"]
+                pre_scores = sum(r["kind"] == "score" for r in rows)
+            except (OSError, ValueError, KeyError):
+                pre_scores = 0
+            if pre_scores >= 1:  # mid-rung: a score is down, no winner yet
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=30)
+                killed = True
+                break
+            time.sleep(0.25)
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        t0 = time.perf_counter()
+        res = subprocess.run(cli, env=env, cwd=str(_HERE),
+                             capture_output=True, text=True, timeout=600)
+        resume_s = time.perf_counter() - t0
+        assert res.returncode == 0, res.stdout + res.stderr
+        out["resume"] = {"resume_s": round(resume_s, 2),
+                         "killed_mid_run": killed,
+                         "scores_adopted": pre_scores}
+
+        # (4) shared-ETL-cache evidence: two lenet_images trials, one
+        # cache_dir, run one-at-a-time — the second trial's decode traffic
+        # should be all hits, read per trial from the merged worker spool
+        data_dir = os.path.join(tmp, "imgs")
+        rs = np.random.RandomState(0)
+        for i in range(int(p["etl_images"])):
+            d = os.path.join(data_dir, f"c{i % 4}")
+            os.makedirs(d, exist_ok=True)
+            Image.fromarray(rs.randint(0, 255, (16, 16), dtype=np.uint8),
+                            mode="L").save(os.path.join(d, f"i{i:03d}.png"))
+        etl_wd = os.path.join(tmp, "etl")
+        etl_task = {"kind": "lenet_images", "data_dir": data_dir,
+                    "cache_dir": os.path.join(tmp, "etl_cache"),
+                    "height": 12, "width": 12, "channels": 1, "batch": 8,
+                    "store_pad": 2, "seed": 5}
+        runner = GangTrialRunner(etl_wd, etl_task, hang_timeout=120.0)
+        fl = TrialFleet(
+            RandomSearchGenerator(
+                {"learning_rate": ContinuousParameterSpace(
+                    1e-3, 1e-2, log_scale=True)}, seed=5),
+            runner, workdir=etl_wd, n_trials=2,
+            rungs=(int(p["etl_iters"]),), pbt=False, seed=5,
+            max_concurrent=1, rung_timeout_s=900.0, trial_max_restarts=1,
+            registry=MetricsRegistry())
+        try:
+            fl.run()
+        finally:
+            fl.close()
+        by_trial = {}
+        for payload in aggregate.read_spools(runner.spool_dir,
+                                             registry=MetricsRegistry()):
+            trial = str(payload.get("proc") or "").split("-")[0]
+            row = by_trial.setdefault(trial, {"hits": 0.0, "misses": 0.0})
+            snap = payload.get("snapshot") or {}
+            for fam, key in (("tdl_etl_cache_hits_total", "hits"),
+                             ("tdl_etl_cache_misses_total", "misses")):
+                for s in (snap.get(fam) or {}).get("series", []):
+                    row[key] += float(s.get("value", 0))
+        out["etl_cache"] = by_trial
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 BENCHES = {"resnet50": bench_resnet50, "lenet": bench_lenet, "lstm": bench_lstm,
            "w2v": bench_w2v, "bert": bench_bert, "serving": bench_serving,
            "serving_slo": bench_serving_slo, "bert_large_fsdp": bench_fsdp,
-           "serving_pool": bench_serving_pool,
+           "serving_pool": bench_serving_pool, "hpo": bench_hpo,
            "pipeline_parallel": bench_pipeline_parallel,
            "reshard": bench_reshard,
            "ckpt_lineage": bench_ckpt_lineage,

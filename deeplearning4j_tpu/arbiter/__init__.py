@@ -6,12 +6,25 @@ candidate generators (random / grid / genetic), ``LocalOptimizationRunner``
 (score functions, termination conditions, result tracking), and
 ``MultiLayerSpace`` mirroring the network builders with spaces at every
 hyperparameter.
+
+Beyond DL4J parity, ``fleet`` adds the fault-isolated PBT/ASHA trial-fleet
+meta-supervisor (ISSUE 20): concurrent trial gangs, rung-based early
+stopping, checkpoint-cloning exploit/explore and a durable sweep journal.
 """
 
+from .fleet import (
+    GangTrialRunner,
+    TrialFleet,
+    TrialRunFailed,
+    TrialSlot,
+    TrialStraggler,
+    spooled_scores,
+)
 from .optimize import (
     CandidateGenerator,
     ContinuousParameterSpace,
     DiscreteParameterSpace,
+    GeneratorExhausted,
     GeneticSearchCandidateGenerator,
     GridSearchCandidateGenerator,
     IntegerParameterSpace,
@@ -38,4 +51,11 @@ __all__ = [
     "MaxCandidatesCondition",
     "MaxTimeCondition",
     "MultiLayerSpace",
+    "GeneratorExhausted",
+    "TrialFleet",
+    "TrialSlot",
+    "TrialStraggler",
+    "TrialRunFailed",
+    "GangTrialRunner",
+    "spooled_scores",
 ]

@@ -10,11 +10,21 @@ conditions + result savers.
 from __future__ import annotations
 
 import math
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+
+
+class GeneratorExhausted(RuntimeError):
+    """``next_candidate()`` on a generator with nothing left. Exhaustion is
+    a normal terminal state for finite generators (grid) — callers poll
+    ``has_more()`` — but an over-draw must fail loudly and typed, not with
+    an ``IndexError`` from an implementation detail: a trial fleet pulling
+    candidates from worker threads needs to tell 'the sweep is smaller than
+    requested' from a genuine bug."""
 
 
 # ----------------------------------------------------------- parameter spaces
@@ -102,23 +112,45 @@ class RandomSearchGenerator(CandidateGenerator):
 
 
 class GridSearchCandidateGenerator(CandidateGenerator):
+    """Exhaustive cartesian product with EXACT exhaustion semantics (ISSUE
+    20 satellite): duplicate grid combos are folded away up front (an
+    ``IntegerParameterSpace``/``DiscreteParameterSpace`` axis can emit the
+    same point twice under a coarse ``discretization_count``), so
+    ``has_more()`` counts candidates that will actually be HANDED OUT —
+    never a phantom trailing duplicate. ``has_more()``/``next_candidate()``
+    share one lock: concurrent callers (a trial fleet filling slots from
+    worker threads) each get a distinct combo, and an over-draw raises
+    :class:`GeneratorExhausted` instead of ``IndexError``. Exhaustion is
+    sticky: once ``has_more()`` is False it stays False."""
+
     def __init__(self, spaces, discretization_count: int = 3, seed: int = 42):
         super().__init__(spaces, seed)
         import itertools
 
         axes = [(k, s.grid_points(discretization_count)) for k, s in spaces.items()]
         names = [k for k, _ in axes]
-        self._grid = [dict(zip(names, combo))
-                      for combo in itertools.product(*[v for _, v in axes])]
+        self._grid, seen = [], set()
+        for combo in itertools.product(*[v for _, v in axes]):
+            key = repr(combo)
+            if key in seen:
+                continue
+            seen.add(key)
+            self._grid.append(dict(zip(names, combo)))
         self._i = 0
+        self._lock = threading.Lock()
 
     def has_more(self):
-        return self._i < len(self._grid)
+        with self._lock:
+            return self._i < len(self._grid)
 
     def next_candidate(self):
-        c = self._grid[self._i]
-        self._i += 1
-        return c
+        with self._lock:
+            if self._i >= len(self._grid):
+                raise GeneratorExhausted(
+                    f"grid of {len(self._grid)} candidates exhausted")
+            c = self._grid[self._i]
+            self._i += 1
+            return c
 
 
 class GeneticSearchCandidateGenerator(CandidateGenerator):
@@ -132,9 +164,12 @@ class GeneticSearchCandidateGenerator(CandidateGenerator):
         self.population = population
         self.mutation_prob = mutation_prob
         self.mutation_sigma = mutation_sigma
-        self._scored: List = []  # (score, u_vector)
+        self._scored: List = []  # (score, cid, u_vector)
         self._pending: Dict[int, np.ndarray] = {}
         self._counter = 0
+        # one lock over rs + pending + scored: trials finish on fleet worker
+        # threads, so draws and score reports genuinely interleave
+        self._lock = threading.Lock()
 
     def _to_candidate(self, u: np.ndarray) -> Dict[str, Any]:
         cand = {k: s.value(float(u[i])) for i, (k, s) in enumerate(self.spaces.items())}
@@ -145,25 +180,37 @@ class GeneticSearchCandidateGenerator(CandidateGenerator):
 
     def next_candidate(self):
         n = len(self.spaces)
-        if len(self._scored) < self.population:
-            return self._to_candidate(self.rs.rand(n))
-        # tournament select two parents (lower score = better)
-        def pick():
-            a, b = self.rs.randint(0, len(self._scored), 2)
-            return self._scored[a] if self._scored[a][0] <= self._scored[b][0] else self._scored[b]
+        with self._lock:
+            if len(self._scored) < self.population:
+                return self._to_candidate(self.rs.rand(n))
+            # tournament select two parents (lower score = better; cid breaks
+            # score ties so the pick never depends on arrival order)
+            def pick():
+                a, b = self.rs.randint(0, len(self._scored), 2)
+                return self._scored[a] if self._scored[a][:2] <= self._scored[b][:2] else self._scored[b]
 
-        (_, pa), (_, pb) = pick(), pick()
-        mask = self.rs.rand(n) < 0.5
-        child = np.where(mask, pa, pb)
-        mut = self.rs.rand(n) < self.mutation_prob
-        child = np.clip(child + mut * self.rs.randn(n) * self.mutation_sigma, 0.0, 1.0 - 1e-9)
-        return self._to_candidate(child)
+            (_, _, pa), (_, _, pb) = pick(), pick()
+            mask = self.rs.rand(n) < 0.5
+            child = np.where(mask, pa, pb)
+            mut = self.rs.rand(n) < self.mutation_prob
+            child = np.clip(child + mut * self.rs.randn(n) * self.mutation_sigma, 0.0, 1.0 - 1e-9)
+            return self._to_candidate(child)
 
     def report_score(self, candidate, score):
+        """Safe under out-of-order and CONCURRENT reports (ISSUE 20
+        satellite): the scored pool is a set ordered by the total key
+        ``(score, cid)`` and truncated to its best ``4 * population`` —
+        any permutation of the same reports converges to the same pool, so
+        subsequent candidates under a fixed seed do not depend on which
+        trial happened to finish first. A duplicate or unknown ``__id__``
+        is ignored (idempotent): a retried trial reporting twice must not
+        double-weight its genome."""
         cid = candidate.get("__id__")
-        if cid in self._pending:
-            self._scored.append((score, self._pending.pop(cid)))
-            self._scored.sort(key=lambda t: t[0])
+        with self._lock:
+            if cid not in self._pending:
+                return
+            self._scored.append((float(score), cid, self._pending.pop(cid)))
+            self._scored.sort(key=lambda t: (t[0], t[1]))
             self._scored = self._scored[: 4 * self.population]
 
 

@@ -96,7 +96,7 @@ CKPT_STAGES = ("shard", "manifest", "commit", "pointer")
 class Fault:
     kind: str   # "crash" | "hang" | "slow_ckpt_io" | "slow_infer"
     #             | "fail_infer" | "torn_ckpt" | "corrupt_ckpt" | "enospc"
-    #             | "loss_spike" | "latency_inject"
+    #             | "loss_spike" | "latency_inject" | "corrupt_clone"
     params: Dict[str, str] = field(default_factory=dict)
 
     @property
@@ -142,7 +142,7 @@ def parse_fault_spec(spec: str) -> List[Fault]:
         kind = kind.strip()
         if kind not in ("crash", "hang", "slow_ckpt_io", "slow_infer",
                         "fail_infer", "torn_ckpt", "corrupt_ckpt", "enospc",
-                        "loss_spike", "latency_inject"):
+                        "loss_spike", "latency_inject", "corrupt_clone"):
             raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
         if kind == "torn_ckpt" and \
                 params.get("stage", "commit") not in CKPT_STAGES:
@@ -165,6 +165,14 @@ class FaultInjector:
       ``TrainingCheckpointer.save`` — ``torn_ckpt`` clauses exit here
     - ``ckpt_committed`` (path=<generation dir>): fired after a successful
       commit — ``corrupt_ckpt`` clauses bit-flip a shard here
+    - ``trial_clone`` (iteration=<rung index>, path=<clone-source
+      generation dir>): fired by the trial fleet (ISSUE 20) just before it
+      deep-verifies a PBT clone source — ``corrupt_clone`` clauses bit-flip
+      the SOURCE shard here, modelling latent disk damage discovered only
+      when the winner's checkpoint is read back. One-shot by default (the
+      fleet's fallback clone from an older generation must not be
+      re-corrupted, or the fault would prove nothing about recovery);
+      ``every=1`` restores fire-on-every-match
     - ``infer``: ``slow_infer`` / ``fail_infer`` clauses
     """
 
@@ -175,6 +183,10 @@ class FaultInjector:
         self.incarnation = (incarnation if incarnation is not None
                             else int(os.environ.get(ENV_INCARNATION, "0")))
         self._infer_calls = 0  # deterministic fail_infer@n= cadence
+        #: clause indices that already fired at a one-shot site
+        #: (``corrupt_clone``): the fleet's FALLBACK clone from an older
+        #: generation must read healthy bytes, or recovery is unprovable
+        self._fired_once: set = set()
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
@@ -206,7 +218,7 @@ class FaultInjector:
              path: Optional[str] = None) -> None:
         if site == "infer":
             self._infer_calls += 1
-        for f in self.faults:
+        for i, f in enumerate(self.faults):
             if site.startswith("ckpt_") and f.kind == "torn_ckpt":
                 # exit at ONE named two-phase-commit boundary: the SIGKILL
                 # kill-matrix (ISSUE 15) — a restorable checkpoint must
@@ -238,6 +250,19 @@ class FaultInjector:
                     "fault injection: corrupt_ckpt bit-flipped %s "
                     "(iteration %s, incarnation %s)", flipped, iteration,
                     self.incarnation)
+            elif site == "trial_clone" and f.kind == "corrupt_clone":
+                if not self._matches(f, iteration) or not path:
+                    continue
+                if f.params.get("every") not in ("1", "true"):
+                    if i in self._fired_once:
+                        continue
+                    self._fired_once.add(i)
+                self._flight_note(f, iteration)
+                flipped = _flip_bit_in_shard(path)
+                log.warning(
+                    "fault injection: corrupt_clone bit-flipped clone "
+                    "source %s (rung %s, incarnation %s)", flipped,
+                    iteration, self.incarnation)
             elif site == "train_step" and f.kind in ("crash", "hang"):
                 if not self._matches(f, iteration):
                     continue

@@ -42,6 +42,11 @@ ENV_INTERVAL = "TDL_FLIGHT_INTERVAL"
 ENV_LOSS_EVERY = "TDL_FLIGHT_LOSS_EVERY"
 ENV_RANK = "TDL_PROCESS_ID"
 ENV_PROC = "TDL_PROC_NAME"
+#: identity namespace for gangs that are one of MANY in a shared spool dir
+#: (ISSUE 20 trial fleets): prepended to the derived ``rank{N}``/``pid{N}``
+#: name, so eight single-rank trial gangs spooling into one fleet dir stay
+#: eight distinct procs instead of eight colliding ``rank0`` spools
+ENV_PROC_PREFIX = "TDL_PROC_PREFIX"
 ENV_RUN_ID = "TDL_RUN_ID"
 
 #: spool filename prefix — the leak-audit conftest fixture and the
@@ -83,6 +88,11 @@ EVENT_KINDS = frozenset({
     "replica_drain_complete", "replica_death", "replica_breaker_open",
     # deployment controller (ISSUE 18)
     "deploy_candidate", "deploy_gate", "deploy_promote", "deploy_rollback",
+    # trial fleet meta-supervisor (ISSUE 20): spawn/score are the per-rung
+    # audit spine; quarantine/demote/clone/promote are the trial-terminal
+    # decisions the fleet lint (tests/test_fleet.py) pins to these kinds
+    "trial_spawn", "trial_score", "trial_rung_promote", "trial_quarantine",
+    "trial_demote", "trial_clone", "trial_promote",
 })
 
 
@@ -113,10 +123,16 @@ def proc_name(rank: Optional[int] = None) -> str:
     explicit = os.environ.get(ENV_PROC)
     if explicit:
         return explicit
+    # ``TDL_PROC_PREFIX`` namespaces the DERIVED name (rank/pid), never an
+    # explicit one: a trial fleet prefixes each gang with its trial id so
+    # many gangs' rank0 spools coexist in one shared dir, while a process
+    # that chose its own TDL_PROC_NAME already owns a unique identity
+    prefix = os.environ.get(ENV_PROC_PREFIX, "")
     if rank is not None:
-        return f"rank{rank}"
+        return f"{prefix}rank{rank}"
     r = os.environ.get(ENV_RANK)
-    return f"rank{int(r)}" if r is not None else f"pid{os.getpid()}"
+    base = f"rank{int(r)}" if r is not None else f"pid{os.getpid()}"
+    return f"{prefix}{base}"
 
 
 def proc_rank() -> Optional[int]:

@@ -225,6 +225,7 @@ class GangSupervisor:
         min_processes: int = 1,
         pipe_stages: int = 1,
         ckpt_dir: Optional[str] = None,
+        proc_prefix: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
     ):
         self.target = target
@@ -267,6 +268,12 @@ class GangSupervisor:
         #: — when set, every postmortem carries a ``checkpoint`` section
         #: with the lineage inventory (committed/torn/quarantined, pointer)
         self.ckpt_dir = ckpt_dir
+        #: telemetry identity namespace (ISSUE 20): prepended to each rank's
+        #: derived proc name (``rank{N}`` → ``<prefix>rank{N}``) so MANY
+        #: gangs spooling into one shared metrics/flight dir — a trial
+        #: fleet — stay distinguishable instead of N ``rank0`` spools
+        #: overwriting each other in the newest-per-proc dedup
+        self.proc_prefix = proc_prefix
         self.registry = registry or get_registry()
         (self._deaths, self._restarts_ctr, self._recovery_hist,
          self._last_failure_info) = _supervisor_metrics(self.registry)
@@ -409,6 +416,11 @@ class GangSupervisor:
         # every rank stamps the gang's run id into its spans/flight events —
         # the fleet timeline groups lanes by it (ISSUE 16)
         env.setdefault(flight.ENV_RUN_ID, self.run_id)
+        if self.proc_prefix:
+            # trial-scoped identity: every rank of this gang spools as
+            # ``<prefix>rank{N}`` — the fleet's shared spool dir stays
+            # collision-free across its many single-rank gangs
+            env.setdefault(flight.ENV_PROC_PREFIX, self.proc_prefix)
         env.setdefault(aggregate.ENV_DIR, self.spool_dir)
         env.setdefault(aggregate.ENV_INTERVAL, str(self.heartbeat_interval))
         # history rings (ISSUE 11) are STABLE across attempts like the

@@ -644,6 +644,111 @@ def lineage_state(directory: str, tag: str = "latest") -> dict:
     return out
 
 
+def quarantine_generation(gendir: str, reason: str, tag: str = "latest",
+                          registry=None) -> Optional[str]:
+    """Module-level quarantine for a caller OUTSIDE a restore walk — the
+    trial fleet (ISSUE 20) condemning a PBT clone SOURCE it will never
+    restore itself. Same discipline as the restore-side ``_quarantine``:
+    rename to ``*.corrupt`` (evidence kept, poison off the restore path),
+    bump the verify-failure/quarantine counters, flight-record the rename.
+    Returns the quarantine path, or None when the rename lost a race."""
+    lineage = os.path.dirname(gendir)
+    name = os.path.basename(gendir)
+    target = gendir + CORRUPT_SUFFIX
+    n = 1
+    while os.path.exists(target):
+        target = f"{gendir}{CORRUPT_SUFFIX}.{n}"
+        n += 1
+    try:
+        os.replace(gendir, target)  # durability-ok: quarantine rename —
+        # losing it to power loss re-detects the same corruption next boot
+    except OSError as e:
+        log.warning("could not quarantine %s: %s", gendir, e)
+        return None
+    durability.fsync_dir(lineage)
+    m = _lineage_metrics(registry)
+    m.verify_failures.labels(reason).inc()
+    m.quarantined.inc()
+    flight.record("ckpt_quarantine", tag=tag, generation=name, reason=reason,
+                  renamed_to=os.path.basename(target))
+    log.error("checkpoint generation %s quarantined -> %s (%s)", name,
+              os.path.basename(target), reason)
+    return target
+
+
+def clone_generation(src_gendir: str, dst_directory: str, tag: str = "latest",
+                     *, deep: bool = True, durable: bool = True,
+                     registry=None) -> dict:
+    """Copy ONE verified committed generation into ANOTHER lineage — the
+    PBT exploit primitive (ISSUE 20): a winner's checkpoint becomes the
+    loser slot's newest generation, without either lineage ever mutating a
+    committed dir in place.
+
+    The source is (deep-)verified FIRST — cloning corrupt bytes would
+    propagate latent disk damage into a healthy trial — and a failure
+    raises :class:`CheckpointVerifyError` with ``.reason`` set, leaving
+    the destination untouched (the fleet quarantines the source and falls
+    back to an older generation). The destination name comes from
+    ``_fresh_gen_name``, so a clone landing at an iteration the loser
+    already committed becomes a suffixed sibling (``gen-<iter>a`` …) that
+    plain (iteration, name) ordering ranks newest — exactly what restore
+    picks up. Commit discipline matches ``TrainingCheckpointer._commit``:
+    shard/manifest/meta bytes (fsynced) first, COMMIT marker second,
+    pointer swap last, so a kill mid-clone leaves a torn dir restore
+    already knows to quarantine."""
+    t0 = time.perf_counter()
+    ok, reason, meta = _verify_generation(src_gendir, deep=deep)
+    if not ok:
+        _lineage_metrics(registry).verify_failures.labels(reason).inc()
+        err = CheckpointVerifyError(
+            f"clone source {src_gendir} failed verification ({reason})")
+        err.reason = reason
+        raise err
+    iteration = int(meta["iteration"])
+    lineage = os.path.join(dst_directory, tag)
+    os.makedirs(lineage, exist_ok=True)
+    gen = _fresh_gen_name(lineage, iteration)
+    ckdir = os.path.join(lineage, gen)
+    if os.path.isdir(ckdir):  # torn leftover owns the name: replace it whole
+        shutil.rmtree(ckdir)
+    os.makedirs(ckdir)
+    # chaos: the clone write is a checkpoint write — enospc@iter= fires here
+    faults.fault_point("ckpt_write", iteration)
+    nbytes = 0
+    for fname in sorted(os.listdir(src_gendir)):
+        src = os.path.join(src_gendir, fname)
+        if fname == _COMMIT_FILE or fname.endswith(".tmp") \
+                or not os.path.isfile(src):
+            continue
+        with open(src, "rb") as f:
+            data = f.read()
+        durability.durable_write_bytes(os.path.join(ckdir, fname), data,
+                                       fsync=durable)
+        nbytes += len(data)
+    if durable:
+        durability.fsync_dir(ckdir)
+    durability.durable_write_json(
+        os.path.join(ckdir, _COMMIT_FILE),
+        {"generation": gen, "iteration": iteration,
+         "process_count": int(meta.get("process_count", 1)),
+         "cloned_from": os.path.basename(src_gendir),
+         "cloned_from_path": src_gendir,
+         "wall": time.time()},  # wallclock-ok: human-facing timestamp
+        fsync=durable)
+    durability.durable_write_bytes(
+        os.path.join(lineage, _POINTER_FILE), (gen + "\n").encode(),
+        fsync=durable)
+    _lineage_metrics(registry).commits.inc()
+    dt = time.perf_counter() - t0
+    flight.record("ckpt_commit", tag=tag, generation=gen,
+                  iteration=iteration,
+                  shards=int(meta.get("process_count", 1)),
+                  seconds=round(dt, 4),
+                  cloned_from=os.path.basename(src_gendir))
+    return {"generation": gen, "iteration": iteration, "path": ckdir,
+            "bytes": nbytes, "seconds": dt, "source": src_gendir}
+
+
 class TrainingCheckpointer:
     """save/restore of (net state, train counters, iterator position).
 

@@ -1,0 +1,826 @@
+"""Fault-isolated trial fleets (ISSUE 20): PBT/ASHA meta-supervisor.
+
+Fast tier drives ``TrialFleet``'s scheduler with in-process runners —
+verdicts, quarantine/straggler/clone decision paths, checkpoint cloning
+against real lineages (including a corrupted clone source falling back to
+an older generation), mid-sweep kill + resume to identical verdicts, the
+``tdl_trial_*`` metric families, the spool score reader, and the
+trial-terminal-decision AST lint (with a planted-offender self-test).
+
+Slow tier runs real trial gangs through ``GangSupervisor``: a chaos sweep
+with injected worker crashes and a deliberately corrupted clone source,
+and a SIGKILLed fleet CLI resuming mid-rung.
+"""
+
+import ast
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                        DiscreteParameterSpace,
+                                        GridSearchCandidateGenerator,
+                                        IntegerParameterSpace,
+                                        RandomSearchGenerator, TrialFleet,
+                                        TrialStraggler, spooled_scores)
+from deeplearning4j_tpu.arbiter import fleet as fleet_mod
+from deeplearning4j_tpu.common import faults
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.monitoring import flight
+from deeplearning4j_tpu.monitoring.registry import MetricsRegistry
+from deeplearning4j_tpu.monitoring.trial import (TRIAL_STATES,
+                                                 set_trial_state,
+                                                 trial_metrics)
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import DenseLayer, InputType, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.serde.checkpoint import (CheckpointVerifyError,
+                                                 TrainingCheckpointer,
+                                                 clone_generation,
+                                                 lineage_state)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+SPACES = {"lr": ContinuousParameterSpace(1e-4, 1e-1, log_scale=True),
+          "hidden": IntegerParameterSpace(4, 32)}
+
+
+def _score_runner(fn):
+    """Adapter: a pure f(hparams, rung_target) -> score as a fleet runner."""
+
+    def runner(slot, target_iter, timeout_s):
+        return fn(slot.hparams, target_iter)
+
+    return runner
+
+
+def _lr_score(hp, target):
+    # deterministic, lr-sensitive, improves with budget — enough structure
+    # for ASHA cuts to be meaningful without training anything
+    import math
+
+    base = 1.0 - abs(math.log10(hp["lr"]) + 2.0) / 4.0
+    return base * (1.0 - 1.0 / (2.0 + target))
+
+
+def _fleet(tmp_path, runner, *, name="f", reg=None, **kw):
+    kw.setdefault("n_trials", 6)
+    kw.setdefault("rungs", (2, 4, 8))
+    kw.setdefault("seed", 5)
+    kw.setdefault("rung_timeout_s", 30.0)
+    kw.setdefault("max_concurrent", 3)
+    gen = kw.pop("generator", None) or RandomSearchGenerator(SPACES, seed=3)
+    return TrialFleet(gen, runner, workdir=str(tmp_path / name),
+                      registry=reg or MetricsRegistry(), **kw)
+
+
+def _journal_kinds(fleet):
+    return [r["kind"] for r in fleet.state["journal"]]
+
+
+def _fleet_events(fleet):
+    spools = flight.read_spools(fleet.flight_dir)
+    return [e for s in spools for e in s.get("events", [])]
+
+
+class TestFleetScheduler:
+    def test_sweep_promotes_a_winner_with_audited_rungs(self, tmp_path):
+        reg = MetricsRegistry()
+        fleet = _fleet(tmp_path, _score_runner(_lr_score), reg=reg)
+        try:
+            winner = fleet.run()
+        finally:
+            fleet.close()
+        assert winner["trial"] in fleet.trials
+        assert fleet.trials[winner["trial"]].status == "winner"
+        # every rung reached a journaled verdict, and the cohort shrank by
+        # the reduction factor at each barrier
+        verdicts = fleet.state["verdicts"]
+        assert set(verdicts) == {"0", "1", "2"}
+        assert len(verdicts["0"]["promoted"]) == 3
+        assert len(verdicts["1"]["promoted"]) == 2
+        # decisions are on the flight spool too (the audit trail contract)
+        kinds = {e["kind"] for e in _fleet_events(fleet)}
+        assert {"trial_spawn", "trial_demote", "trial_rung_promote",
+                "trial_promote"} <= kinds
+        # and in the metrics: exactly one winner state, promotions counted
+        snap = reg.snapshot()
+        winners = [s for s in snap["tdl_trial_state"]["series"]
+                   if s["labels"]["state"] == "winner" and s["value"] == 1.0]
+        assert len(winners) == 1
+        assert snap["tdl_trial_rung_promotions_total"]["series"][0]["value"] >= 3
+        assert snap["tdl_fleet_disk_bytes"]["series"][0]["value"] > 0
+
+    def test_run_is_reentrant_after_completion(self, tmp_path):
+        fleet = _fleet(tmp_path, _score_runner(_lr_score))
+        try:
+            first = fleet.run()
+            assert fleet.run() == first  # journaled winner, no re-run
+        finally:
+            fleet.close()
+
+    def test_crashing_trial_is_quarantined_and_sweep_survives(self, tmp_path):
+        reg = MetricsRegistry()
+        calls = {}
+
+        def runner(slot, target, timeout_s):
+            calls[slot.trial_id] = calls.get(slot.trial_id, 0) + 1
+            if slot.trial_id == "t00":
+                raise RuntimeError("boom (injected)")
+            return _lr_score(slot.hparams, target)
+
+        fleet = _fleet(tmp_path, runner, reg=reg, trial_max_restarts=2,
+                       backoff_base_s=0.01, backoff_max_s=0.02)
+        try:
+            winner = fleet.run()
+        finally:
+            fleet.close()
+        assert winner["trial"] != "t00"
+        t0 = fleet.trials["t00"]
+        assert t0.status == "quarantined"
+        assert t0.quarantine_reason == "crash_budget"
+        assert calls["t00"] == 3  # initial + trial_max_restarts retries
+        ev = [e for e in _fleet_events(fleet)
+              if e["kind"] == "trial_quarantine"]
+        assert ev and ev[0]["trial"] == "t00" \
+            and ev[0]["reason"] == "crash_budget"
+        series = MetricsRegistry.snapshot(reg)["tdl_trial_quarantined_total"]
+        assert {(s["labels"]["reason"], s["value"])
+                for s in series["series"]} == {("crash_budget", 1.0)}
+
+    def test_wedged_gang_quarantines_as_wedged(self, tmp_path):
+        class Hung(RuntimeError):
+            classification = "hang"
+
+        def runner(slot, target, timeout_s):
+            if slot.trial_id == "t01":
+                raise Hung("gang died hanging")
+            return _lr_score(slot.hparams, target)
+
+        fleet = _fleet(tmp_path, runner, trial_max_restarts=1,
+                       backoff_base_s=0.01, backoff_max_s=0.02)
+        try:
+            fleet.run()
+        finally:
+            fleet.close()
+        assert fleet.trials["t01"].quarantine_reason == "wedged"
+
+    def test_straggler_is_demoted_not_waited_for(self, tmp_path):
+        started = time.monotonic()
+
+        def runner(slot, target, timeout_s):
+            if slot.trial_id == "t02":
+                raise TrialStraggler("over rung deadline")
+            return _lr_score(slot.hparams, target)
+
+        fleet = _fleet(tmp_path, runner)
+        try:
+            winner = fleet.run()
+        finally:
+            fleet.close()
+        assert time.monotonic() - started < 20.0
+        assert winner["trial"] != "t02"
+        assert fleet.trials["t02"].status == "demoted"
+        demotes = [r for r in fleet.state["journal"] if r["kind"] == "demote"
+                   and r["trial"] == "t02"]
+        assert demotes and demotes[0]["reason"] == "straggler"
+        # a straggler is NOT a crash: no restart burned, no quarantine
+        assert fleet.trials["t02"].restarts == 0
+
+    def test_timeout_classified_exception_also_demotes(self, tmp_path):
+        class GangTimeout(RuntimeError):
+            classification = "timeout"
+
+        def runner(slot, target, timeout_s):
+            if slot.trial_id == "t00":
+                raise GangTimeout("rung budget exceeded")
+            return _lr_score(slot.hparams, target)
+
+        fleet = _fleet(tmp_path, runner)
+        try:
+            fleet.run()
+        finally:
+            fleet.close()
+        assert fleet.trials["t00"].status == "demoted"
+
+    def test_rung_deadline_demotes_inline_sleeper(self, tmp_path):
+        """A runner that simply blows the wall-clock deadline is demoted by
+        the NEXT budget check — the rung barrier stays bounded."""
+
+        def runner(slot, target, timeout_s):
+            if slot.trial_id == "t00":
+                time.sleep(0.4)
+                raise RuntimeError("crashed after eating the rung budget")
+            return _lr_score(slot.hparams, target)
+
+        fleet = _fleet(tmp_path, runner, rung_timeout_s=0.2,
+                       trial_max_restarts=5, backoff_base_s=0.01)
+        try:
+            fleet.run()
+        finally:
+            fleet.close()
+        t0 = fleet.trials["t00"]
+        assert t0.status == "demoted"
+
+    def test_all_trials_dead_raises_not_invents_winner(self, tmp_path):
+        def runner(slot, target, timeout_s):
+            raise RuntimeError("everything burns")
+
+        fleet = _fleet(tmp_path, runner, n_trials=3, trial_max_restarts=0,
+                       backoff_base_s=0.01)
+        try:
+            with pytest.raises(RuntimeError, match="no surviving"):
+                fleet.run()
+        finally:
+            fleet.close()
+
+    def test_generator_exhaustion_shrinks_sweep(self, tmp_path):
+        gen = GridSearchCandidateGenerator(
+            {"lr": DiscreteParameterSpace(1e-3, 1e-2),
+             "hidden": DiscreteParameterSpace(8, 16)})
+        fleet = _fleet(tmp_path, _score_runner(_lr_score), generator=gen,
+                       n_trials=16)
+        try:
+            fleet.run()
+        finally:
+            fleet.close()
+        assert len(fleet.trials) == 4  # the grid, not the ask
+
+
+class TestFleetResume:
+    def _reference(self, tmp_path, runner):
+        ref = _fleet(tmp_path, runner, name="ref")
+        try:
+            ref.run()
+        finally:
+            ref.close()
+        return ref
+
+    def test_killed_mid_rung_resumes_to_identical_verdicts(self, tmp_path):
+        class KilledMidRung(BaseException):
+            """Out-of-band death: not an Exception, so no retry path."""
+
+        run_counts = {}
+
+        def make_runner(kill_at=None):
+            def runner(slot, target, timeout_s):
+                key = (slot.trial_id, target)
+                run_counts[key] = run_counts.get(key, 0) + 1
+                if kill_at == key:
+                    raise KilledMidRung()
+                return _lr_score(slot.hparams, target)
+
+            return runner
+
+        ref = self._reference(tmp_path, make_runner())
+        ref_scored = dict(run_counts)
+
+        run_counts.clear()
+        # first incarnation dies when t01 reaches rung 1 — rung 0 verdict is
+        # journaled, rung 1 is mid-flight
+        fleet = _fleet(tmp_path, make_runner(kill_at=("t01", 4)),
+                       name="killed", max_concurrent=1)
+        with pytest.raises(KilledMidRung):
+            fleet.run()
+        fleet.close()
+        pre_crash = {k for k, v in run_counts.items() if v}
+
+        run_counts.clear()
+        resumed = _fleet(tmp_path, make_runner(), name="killed")
+        assert resumed.state["resumed"]
+        try:
+            winner = resumed.run()
+        finally:
+            resumed.close()
+        # identical verdicts, winner and scores as the uninterrupted run
+        assert resumed.state["verdicts"] == ref.state["verdicts"]
+        assert winner["trial"] == ref.state["winner"]["trial"]
+        assert winner["score"] == ref.state["winner"]["score"]
+        for tid, ref_slot in ref.trials.items():
+            assert resumed.trials[tid].scores == ref_slot.scores
+        # journaled pre-crash scores were NOT re-run by the resume
+        rerun = [k for k, v in run_counts.items()
+                 if k in pre_crash and k != ("t01", 4)]
+        assert not rerun, f"resume re-ran journaled trials: {rerun}"
+        # and the union of both incarnations equals the reference's work
+        assert pre_crash | set(run_counts) == set(ref_scored)
+
+    def test_resume_skips_completed_rungs_entirely(self, tmp_path):
+        fleet = _fleet(tmp_path, _score_runner(_lr_score), name="done")
+        try:
+            fleet.run()
+        finally:
+            fleet.close()
+
+        def exploding(slot, target, timeout_s):
+            raise AssertionError("a finished sweep must not run trials")
+
+        again = _fleet(tmp_path, exploding, name="done")
+        try:
+            assert again.run()["trial"] == fleet.state["winner"]["trial"]
+        finally:
+            again.close()
+
+
+# ------------------------------------------------------- checkpoint cloning
+
+
+def _net(seed=5):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _trained_lineage(directory, steps=3, seed=5, keep_last=8):
+    net = _net(seed)
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 32)]
+    ck = TrainingCheckpointer(directory, async_write=False,
+                              keep_last=keep_last)
+    for _ in range(steps):
+        net._fit_batch(DataSet(x, y))
+        ck.save(net)
+    return net
+
+
+class TestCloneGeneration:
+    def test_clone_lands_as_restorable_suffixed_sibling(self, tmp_path):
+        src_net = _trained_lineage(str(tmp_path / "win"))
+        # the loser has its OWN generation at the same iteration: the clone
+        # must land as a suffixed sibling that outranks it on restore
+        _trained_lineage(str(tmp_path / "lose"), seed=77)
+        src_gen = lineage_state(str(tmp_path / "win"))["newest_committed"]
+        got = clone_generation(os.path.join(str(tmp_path / "win"),
+                                            "latest", src_gen),
+                               str(tmp_path / "lose"))
+        assert got["generation"] != src_gen  # suffixed, not overwritten
+        assert got["generation"].startswith(src_gen)
+        assert got["iteration"] == int(src_net.iteration)
+        restored = _net(seed=1)
+        assert TrainingCheckpointer(str(tmp_path / "lose"),
+                                    async_write=False).restore(restored)
+        import jax
+
+        for a, b in zip(jax.tree.leaves(src_net.params_),
+                        jax.tree.leaves(restored.params_)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        commit = json.load(open(os.path.join(got["path"], "COMMIT")))
+        assert commit["cloned_from"] == src_gen
+
+    def test_corrupt_source_raises_typed_verify_error(self, tmp_path):
+        _trained_lineage(str(tmp_path / "win"), steps=1)
+        lineage = os.path.join(str(tmp_path / "win"), "latest")
+        gen = lineage_state(str(tmp_path / "win"))["newest_committed"]
+        faults._flip_bit_in_shard(os.path.join(lineage, gen))
+        with pytest.raises(CheckpointVerifyError) as ei:
+            clone_generation(os.path.join(lineage, gen),
+                             str(tmp_path / "lose"))
+        assert ei.value.reason
+        assert not lineage_state(str(tmp_path / "lose"))["committed"]
+
+
+class TestFleetClonePaths:
+    def _two_trial_fleet(self, tmp_path, reg=None):
+        fleet = _fleet(tmp_path, _score_runner(_lr_score), n_trials=4,
+                       reg=reg, pbt_quantile=0.25)
+        for tid in ("t00", "t01"):
+            _trained_lineage(fleet.trials[tid].ckpt_dir, steps=2,
+                             seed=5 if tid == "t00" else 7)
+        return fleet
+
+    def test_clone_into_slot_ok_perturbs_loser(self, tmp_path):
+        reg = MetricsRegistry()
+        fleet = self._two_trial_fleet(tmp_path, reg)
+        try:
+            loser, winner = fleet.trials["t01"], fleet.trials["t00"]
+            before = dict(loser.hparams)
+            outcome = fleet._clone_into_slot(loser, winner, rung=1)
+        finally:
+            fleet.close()
+        assert outcome == "ok"
+        assert loser.cloned_from.startswith("t00/")
+        assert loser.hparams != before
+        # perturbation stays inside the space bounds
+        assert 1e-4 <= loser.hparams["lr"] <= 1e-1
+        # shape-bearing int hyperparameters are inherited VERBATIM from the
+        # winner: the cloned weights must still fit the net
+        assert loser.hparams["hidden"] == winner.hparams["hidden"]
+        # the loser's own stale lineage was retired: only the clone remains
+        inv = lineage_state(loser.ckpt_dir)
+        assert [g["generation"] for g in inv["committed"]] \
+            == [loser.cloned_from.split("/", 1)[1]]
+        series = reg.snapshot()["tdl_trial_clones_total"]["series"]
+        assert {(s["labels"]["outcome"], s["value"])
+                for s in series} == {("ok", 1.0)}
+        clones = [r for r in fleet.state["journal"] if r["kind"] == "clone"]
+        assert clones[0]["outcome"] == "ok"
+        assert clones[0]["new_hparams"] == {
+            k: v for k, v in loser.hparams.items() if k != "__id__"}
+
+    def test_perturbation_is_resume_deterministic(self, tmp_path):
+        fleet = self._two_trial_fleet(tmp_path)
+        try:
+            winner = fleet.trials["t00"]
+            a = fleet._perturb(winner.hparams, fleet._rs("pbt", 1, "t01"))
+            b = fleet._perturb(winner.hparams, fleet._rs("pbt", 1, "t01"))
+            spread = {json.dumps(
+                fleet._perturb(winner.hparams, fleet._rs("pbt", r, "t01")),
+                sort_keys=True) for r in range(16)}
+        finally:
+            fleet.close()
+        assert a == b  # same (seed, rung, loser) → identical explore
+        assert len(spread) > 1  # different rungs do explore differently
+        assert all(json.loads(s)["hidden"] == winner.hparams["hidden"]
+                   for s in spread)
+
+    def test_corrupt_newest_falls_back_to_older_generation(self, tmp_path):
+        reg = MetricsRegistry()
+        fleet = self._two_trial_fleet(tmp_path, reg)
+        try:
+            winner = fleet.trials["t00"]
+            lineage = os.path.join(winner.ckpt_dir, "latest")
+            newest = lineage_state(winner.ckpt_dir)["newest_committed"]
+            faults._flip_bit_in_shard(os.path.join(lineage, newest))
+            outcome = fleet._clone_into_slot(fleet.trials["t01"], winner, 1)
+        finally:
+            fleet.close()
+        assert outcome == "fallback"
+        # the corrupt source is quarantined as evidence, off the clone path
+        inv = lineage_state(winner.ckpt_dir)
+        assert newest not in [g["generation"] for g in inv["committed"]]
+        assert inv["quarantined"]
+        # loser actually received the older generation
+        loser_inv = lineage_state(fleet.trials["t01"].ckpt_dir)
+        assert loser_inv["newest_committed"]
+        ev = [e for e in _fleet_events(fleet) if e["kind"] == "trial_clone"]
+        assert ev and ev[0]["outcome"] == "fallback" and ev[0]["quarantined"]
+        series = reg.snapshot()["tdl_trial_clones_total"]["series"]
+        assert {(s["labels"]["outcome"], s["value"])
+                for s in series} == {("fallback", 1.0)}
+        # winner itself survives: one bad generation is not a bad trial
+        assert winner.status != "quarantined"
+
+    def test_fully_corrupt_winner_is_quarantined_loser_keeps_weights(
+            self, tmp_path):
+        reg = MetricsRegistry()
+        fleet = self._two_trial_fleet(tmp_path, reg)
+        try:
+            winner = fleet.trials["t00"]
+            lineage = os.path.join(winner.ckpt_dir, "latest")
+            for g in lineage_state(winner.ckpt_dir)["committed"]:
+                faults._flip_bit_in_shard(os.path.join(lineage,
+                                                       g["generation"]))
+            loser = fleet.trials["t01"]
+            before_inv = lineage_state(loser.ckpt_dir)["newest_committed"]
+            before_hp = dict(loser.hparams)
+            outcome = fleet._clone_into_slot(loser, winner, 1)
+        finally:
+            fleet.close()
+        assert outcome == "failed"
+        assert winner.status == "quarantined"
+        assert winner.quarantine_reason == "clone_source"
+        # the loser is untouched: same weights, same hyperparameters
+        assert lineage_state(loser.ckpt_dir)["newest_committed"] == before_inv
+        assert loser.hparams == before_hp
+        series = reg.snapshot()["tdl_trial_clones_total"]["series"]
+        assert {(s["labels"]["outcome"], s["value"])
+                for s in series} == {("failed", 1.0)}
+        reasons = reg.snapshot()["tdl_trial_quarantined_total"]["series"]
+        assert {s["labels"]["reason"] for s in reasons} == {"clone_source"}
+
+    def test_injected_corrupt_clone_fault_is_one_shot(self, tmp_path,
+                                                      monkeypatch):
+        """The chaos clause: ``corrupt_clone`` bit-flips the FIRST clone
+        source read, the fallback read sees healthy bytes — recovery is
+        provable."""
+        monkeypatch.setenv(faults.ENV_SPEC, "corrupt_clone")
+        fleet = self._two_trial_fleet(tmp_path)
+        try:
+            outcome = fleet._clone_into_slot(fleet.trials["t01"],
+                                             fleet.trials["t00"], 0)
+        finally:
+            fleet.close()
+        assert outcome == "fallback"  # corrupted once, older gen healthy
+
+
+# ------------------------------------------------------------- spool reader
+
+
+class TestSpooledScores:
+    def _spool(self, d, proc, wall, trial, score, iteration):
+        payload = {"proc": proc, "wall": wall, "snapshot": {
+            "tdl_trial_score": {"type": "gauge", "series": [
+                {"labels": {"trial": trial}, "value": score}]},
+            "tdl_trial_iteration": {"type": "gauge", "series": [
+                {"labels": {"trial": trial}, "value": iteration}]}}}
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"tdl_metrics_{proc}.1.json"), "w") as f:
+            json.dump(payload, f)
+
+    def test_newest_iteration_wins_across_procs(self, tmp_path):
+        d = str(tmp_path)
+        self._spool(d, "t00-rank0", 1.0, "t00", 0.5, 4)
+        self._spool(d, "t01-rank0", 2.0, "t01", 0.7, 8)
+        got = spooled_scores(d, registry=MetricsRegistry())
+        assert got == {"t00": (4, 0.5), "t01": (8, 0.7)}
+
+    def test_torn_spool_degrades_not_raises(self, tmp_path):
+        d = str(tmp_path)
+        self._spool(d, "t00-rank0", 1.0, "t00", 0.5, 4)
+        with open(os.path.join(d, "tdl_metrics_t01-rank0.1.json"), "w") as f:
+            f.write('{"torn')
+        reg = MetricsRegistry()
+        assert spooled_scores(d, registry=reg) == {"t00": (4, 0.5)}
+        errs = reg.snapshot()["tdl_spool_read_errors_total"]["series"]
+        assert sum(s["value"] for s in errs) == 1.0
+
+
+# ------------------------------------------------------------------ metrics
+
+
+class TestTrialMetrics:
+    def test_state_gauge_is_exclusive_per_trial(self):
+        reg = MetricsRegistry()
+        m = trial_metrics(reg)
+        set_trial_state(m, "t00", "running")
+        set_trial_state(m, "t00", "quarantined")
+        set_trial_state(m, "t01", "running")
+        series = {(s["labels"]["trial"], s["labels"]["state"]): s["value"]
+                  for s in reg.snapshot()["tdl_trial_state"]["series"]}
+        assert series[("t00", "quarantined")] == 1.0
+        assert series[("t00", "running")] == 0.0
+        assert series[("t01", "running")] == 1.0
+        assert sum(v for (t, _), v in series.items() if t == "t00") == 1.0
+
+    def test_unknown_state_is_a_bug_not_a_label(self):
+        m = trial_metrics(MetricsRegistry())
+        with pytest.raises(ValueError):
+            set_trial_state(m, "t00", "confused")
+
+    def test_all_families_declared(self):
+        reg = MetricsRegistry()
+        trial_metrics(reg)
+        snap = reg.snapshot()
+        assert {"tdl_trial_state", "tdl_trial_rung_promotions_total",
+                "tdl_trial_quarantined_total", "tdl_trial_clones_total",
+                "tdl_fleet_disk_bytes", "tdl_trial_score",
+                "tdl_trial_iteration"} <= set(snap)
+
+
+# ---------------------------------------- trial-terminal decision AST lint
+
+
+_DECISION_EVENTS = {
+    "_quarantine_trial": "trial_quarantine",
+    "_demote_trial": "trial_demote",
+    "_clone_into_slot": "trial_clone",
+    "_promote_winner": "trial_promote",
+}
+
+
+def _record_literals(node):
+    """Every ``*.record("<literal>", ...)`` / ``*._record("<literal>", ...)``
+    call under ``node``: (kind, lineno)."""
+    out = []
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("record", "_record")
+                and sub.args
+                and isinstance(sub.args[0], ast.Constant)
+                and isinstance(sub.args[0].value, str)):
+            out.append((sub.args[0].value, sub.lineno))
+    return out
+
+
+def _unflighted_decision_paths(tree):
+    """Offenders: a trial-terminal decision method that never records its
+    flight kind, or that can RETURN before the first record (a delegated
+    ``return self._other_decision(...)`` is exempt — the callee records)."""
+    offenders = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef) \
+                or node.name not in _DECISION_EVENTS:
+            continue
+        kind = _DECISION_EVENTS[node.name]
+        recs = [ln for k, ln in _record_literals(node) if k == kind]
+
+        def _delegated(ret):
+            v = ret.value
+            return (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr in _DECISION_EVENTS)
+
+        returns = [sub for sub in ast.walk(node)
+                   if isinstance(sub, ast.Return)]
+        if not recs:
+            # a method that only ever delegates to another decision method
+            # is audited by the callee
+            if not (returns and all(map(_delegated, returns))):
+                offenders.append(f"{node.name}: never records {kind!r}")
+            continue
+        first = min(recs)
+        for sub in returns:
+            if sub.lineno >= first or _delegated(sub):
+                continue
+            offenders.append(
+                f"{node.name}:{sub.lineno} returns before recording {kind!r}")
+    return offenders
+
+
+def test_every_trial_terminal_decision_records_a_flight_event():
+    src = (ROOT / "deeplearning4j_tpu" / "arbiter" / "fleet.py").read_text()
+    tree = ast.parse(src, filename="arbiter/fleet.py")
+    found = {node.name for node in ast.walk(tree)
+             if isinstance(node, ast.FunctionDef)}
+    missing = set(_DECISION_EVENTS) - found
+    assert not missing, f"decision methods renamed/removed: {missing}"
+    offenders = _unflighted_decision_paths(tree)
+    assert not offenders, (
+        "trial-terminal decision paths without a flight event "
+        f"(the sweep audit trail would silently lose verdicts): {offenders}")
+
+
+def test_decision_lint_catches_planted_offenders():
+    planted = ast.parse(textwrap.dedent("""
+        class F:
+            def _quarantine_trial(self, slot, rung, reason):
+                self.count += 1  # decided, never audited
+
+            def _demote_trial(self, slot, rung, reason):
+                if reason == "straggler":
+                    return None  # early exit skips the audit
+                self._record("trial_demote", trial=slot.trial_id)
+
+            def _clone_into_slot(self, loser, winner, rung):
+                self._record("trial_clone", outcome="ok")
+                return "ok"
+
+            def _promote_winner(self, slot, score):
+                return self._quarantine_trial(slot, 0, "x")  # delegated: ok
+    """))
+    offenders = _unflighted_decision_paths(planted)
+    assert len(offenders) == 2
+    assert any("_quarantine_trial" in o for o in offenders)
+    assert any("_demote_trial" in o for o in offenders)
+    assert not any("_clone_into_slot" in o for o in offenders)
+    assert not any("_promote_winner" in o for o in offenders)
+
+
+def test_fleet_trial_kinds_are_registered_event_kinds():
+    for kind in ("trial_spawn", "trial_score", "trial_rung_promote",
+                 *_DECISION_EVENTS.values()):
+        assert kind in flight.EVENT_KINDS
+
+
+# ------------------------------------------------------------ slow: chaos
+
+
+def _write_fleet_config(tmp_path, workdir, *, n_trials=6, rungs=(2, 4),
+                        extra=None):
+    cfg = {
+        "workdir": workdir,
+        "generator": "random",
+        "seed": 7,
+        "n_trials": n_trials,
+        "rungs": list(rungs),
+        "reduction": 2,
+        "max_concurrent": 2,
+        "rung_timeout_s": 240.0,
+        "trial_max_restarts": 2,
+        "backoff_base_s": 0.1,
+        "backoff_max_s": 0.5,
+        "hang_timeout": 20.0,
+        "task": {"kind": "synth_classify", "seed": 11},
+        "spaces": {
+            "learning_rate": {"kind": "continuous", "lo": 1e-3, "hi": 1e-1,
+                              "log_scale": True},
+            "hidden": {"kind": "integer", "lo": 4, "hi": 32},
+        },
+    }
+    cfg.update(extra or {})
+    path = tmp_path / "fleet_config.json"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+@pytest.mark.slow
+class TestFleetGangChaos:
+    def test_gang_sweep_survives_crashes_and_corrupt_clone(
+            self, tmp_path, monkeypatch):
+        """The chaos acceptance core, sized for CI: a real-gang sweep where
+        one trial's worker crashes (supervisor restarts it), another crashes
+        EVERY incarnation (quarantined), and the fleet-side corrupt_clone
+        fault bit-flips the first PBT clone source (fallback evidenced in
+        flight events and the journal). The sweep still promotes a winner
+        whose score is readable from the merged spool."""
+        from deeplearning4j_tpu.arbiter.fleet import GangTrialRunner
+
+        wd = str(tmp_path / "sweep")
+
+        def fault_spec_for(slot):
+            if slot.trial_id == "t01":
+                return "crash@iteration=1,restart=0"  # once, then clean
+            if slot.trial_id == "t03":
+                return "crash@iteration=1,every=1"  # unrecoverable
+            return ""
+
+        monkeypatch.setenv(faults.ENV_SPEC, "corrupt_clone")
+        gen = RandomSearchGenerator(
+            {"learning_rate": ContinuousParameterSpace(1e-3, 1e-1,
+                                                       log_scale=True),
+             "hidden": IntegerParameterSpace(4, 32)}, seed=7)
+        runner = GangTrialRunner(
+            wd, {"kind": "synth_classify", "seed": 11},
+            gang_max_restarts=2, hang_timeout=30.0,
+            fault_spec_for=fault_spec_for)
+        reg = MetricsRegistry()
+        fleet = TrialFleet(gen, runner, workdir=wd, n_trials=6,
+                           rungs=(2, 4), reduction=2, pbt=True,
+                           pbt_quantile=0.34, seed=7, registry=reg,
+                           rung_timeout_s=420.0, trial_max_restarts=1,
+                           backoff_base_s=0.1, max_concurrent=2)
+        try:
+            winner = fleet.run()
+        finally:
+            fleet.close()
+        assert winner["trial"] != "t03"
+        # the always-crashing trial burned its budgets and was quarantined
+        assert fleet.trials["t03"].status == "quarantined"
+        # the restarted trial survived its single crash
+        assert fleet.trials["t01"].status != "quarantined"
+        # every trial's score is distinguishable in ONE merged scrape
+        scores = spooled_scores(runner.spool_dir, registry=reg)
+        scored_ids = {t.trial_id for t in fleet.trials.values()
+                      if t.scores}
+        assert scored_ids <= set(scores)
+        # the corrupt_clone either hit a clone (fallback journaled) or no
+        # clone happened this sweep — if one did, recovery must be evidenced
+        clones = [r for r in fleet.state["journal"] if r["kind"] == "clone"]
+        if clones:
+            assert clones[0]["outcome"] in ("fallback", "ok")
+        # disk bounded: demoted trials' lineages collapsed to one generation
+        for t in fleet.trials.values():
+            if t.status in ("demoted", "quarantined"):
+                assert len(lineage_state(t.ckpt_dir)["committed"]) <= 1
+
+    def test_sigkilled_fleet_cli_resumes_mid_rung(self, tmp_path):
+        """SIGKILL the unattended fleet CLI mid-sweep; rerunning the same
+        config resumes from the journal and finishes with a winner whose
+        pre-kill journaled scores were not recomputed."""
+        wd = str(tmp_path / "sweep")
+        cfg = _write_fleet_config(tmp_path, wd, n_trials=4, rungs=(2, 4))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "deeplearning4j_tpu.arbiter.fleet", cfg],
+            env=env, cwd=str(ROOT), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        state_path = os.path.join(wd, "fleet_state.json")
+        deadline = time.monotonic() + 300.0
+        journaled = 0
+        try:
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    break  # finished before we could kill it — still fine
+                try:
+                    journaled = len(json.load(open(state_path))["journal"])
+                except (OSError, ValueError, KeyError):
+                    journaled = 0
+                if journaled >= 2:  # mid-rung: some scores down, no winner
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait(timeout=30)
+                    break
+                time.sleep(0.5)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        pre = json.load(open(state_path))
+        pre_scores = {(r["trial"], r["rung"]): r["score"]
+                      for r in pre["journal"] if r["kind"] == "score"}
+        out = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.arbiter.fleet", cfg],
+            env=env, cwd=str(ROOT), capture_output=True, text=True,
+            timeout=540)
+        assert out.returncode == 0, out.stdout + out.stderr
+        winner = json.loads(out.stdout.strip().splitlines()[-1])
+        post = json.load(open(state_path))
+        assert post["winner"]["trial"] == winner["trial"]
+        # pre-kill journaled scores survived verbatim (not recomputed)
+        post_scores = {(r["trial"], r["rung"]): r["score"]
+                       for r in post["journal"] if r["kind"] == "score"}
+        for key, score in pre_scores.items():
+            assert post_scores[key] == score
